@@ -1,0 +1,22 @@
+"""Capture layer: window snapshot contracts and sample sources.
+
+The reference's L0 is an eBPF program aggregating (pid, stack) -> count in
+kernel BPF maps, drained every 10 s (reference bpf/cpu/cpu.bpf.c:110-116,
+pkg/profiler/cpu/cpu.go:505). Our capture layer is re-designed around a single
+immutable *WindowSnapshot* value — fixed-width, zero-padded arrays that map
+directly onto TPU-friendly layouts — produced by pluggable sources:
+
+  - SyntheticSource: parameterized workload generator (BASELINE configs #2/#4)
+  - ReplaySource:    replays saved snapshot fixtures (testdata replay)
+  - native perf source: C++ perf_event sampler (parca_agent_tpu/native)
+"""
+
+from parca_agent_tpu.capture.formats import (  # noqa: F401
+    MAX_STACK_DEPTH,
+    STACK_SLOTS,
+    KERNEL_ADDR_START,
+    MappingTable,
+    WindowSnapshot,
+    load_snapshot,
+    save_snapshot,
+)

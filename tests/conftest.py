@@ -1,0 +1,15 @@
+"""Test harness config: run JAX on a simulated 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness is
+tested on virtual CPU devices per SURVEY.md section 4's closing note.
+Must run before anything imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
