@@ -1,0 +1,20 @@
+"""Pluggable window aggregation (the reference's hot loop, re-designed).
+
+The reference folds drained stack counts into per-PID profiles one map entry
+at a time inside `obtainProfiles` (reference pkg/profiler/cpu/cpu.go:505-718).
+Here aggregation is a pluggable `Aggregator` with three implementations:
+
+  NaiveAggregator  dict-based spec oracle; the executable definition of the
+                   semantics, used only in tests
+  CPUAggregator    vectorized numpy path; the default backend
+  TPUAggregator    batched JAX/XLA path over all PIDs at once (radix hash +
+                   sort + segment reductions), the flagship backend
+"""
+
+from parca_agent_tpu.aggregator.base import (  # noqa: F401
+    Aggregator,
+    PidProfile,
+    ProfileMapping,
+    WindowProfiles,
+)
+from parca_agent_tpu.aggregator.cpu import CPUAggregator, NaiveAggregator  # noqa: F401

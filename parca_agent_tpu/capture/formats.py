@@ -90,6 +90,13 @@ class MappingTable:
             order = np.lexsort((self.starts, self.pids))
             if not np.array_equal(order, np.arange(m)):
                 raise ValueError("mapping rows must be sorted by (pid, start)")
+            # VMAs are disjoint within a process (kernel invariant); the
+            # aggregators' binary-search join relies on it.
+            same_pid = self.pids[1:] == self.pids[:-1]
+            if np.any(same_pid & (self.starts[1:] < self.ends[:-1])):
+                raise ValueError("mappings overlap within a pid")
+            if np.any(self.ends < self.starts):
+                raise ValueError("mapping end precedes start")
 
     def __len__(self) -> int:
         return len(self.pids)

@@ -143,11 +143,15 @@ def generate(spec: SyntheticSpec) -> WindowSnapshot:
     if len(uniq):
         # Weight each unique stack by how often the Zipf draw picked it, so
         # counts carry the heavy-hitter skew the sketch benchmarks need.
+        # Rows drawing zero samples are dropped so the window's total is
+        # exactly spec.total_samples.
         picks = np.bincount(inv).astype(np.float64)
         per_row = rng.multinomial(spec.total_samples, picks / picks.sum())
+        keep = per_row > 0
+        uniq, per_row = uniq[keep], per_row[keep]
     else:
         per_row = np.zeros(0, np.int64)
-    counts = np.maximum(per_row, 1).astype(np.int64)
+    counts = per_row.astype(np.int64)
 
     sel = uniq.astype(np.int64)
     pids = (1000 + pid_of_stack[sel]).astype(np.int32)

@@ -1,0 +1,10 @@
+"""pprof profile.proto encoding without a protobuf runtime dependency.
+
+The reference converts its per-PID sample maps to pprof via the google/pprof
+library (pkg/profiler/pprof.go:24-72) and ships gzip-compressed serialized
+profiles. We implement the profile.proto wire format directly (proto.py) and
+build profiles straight from the aggregator's array tables (builder.py), so
+the encode path has no per-sample Python object churn.
+"""
+
+from parca_agent_tpu.pprof.builder import build_pprof, parse_pprof  # noqa: F401

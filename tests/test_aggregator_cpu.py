@@ -1,0 +1,128 @@
+import numpy as np
+import pytest
+
+from parca_agent_tpu.aggregator import CPUAggregator, NaiveAggregator, PidProfile
+from parca_agent_tpu.capture.formats import (
+    KERNEL_ADDR_START,
+    STACK_SLOTS,
+    MappingTable,
+    WindowSnapshot,
+)
+from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+
+def canonical(prof: PidProfile) -> dict:
+    """Order-insensitive view: {addr-stack: count} + location attribute maps."""
+    stacks = {}
+    for i in range(prof.n_samples):
+        d = int(prof.stack_depths[i])
+        key = tuple(
+            int(prof.loc_address[prof.stack_loc_ids[i, j] - 1]) for j in range(d)
+        )
+        stacks[key] = stacks.get(key, 0) + int(prof.values[i])
+    locs = {
+        int(prof.loc_address[j]): (
+            int(prof.loc_normalized[j]),
+            # map to (start,end) rather than id: id numbering may differ
+            (prof.mappings[int(prof.loc_mapping_id[j]) - 1].start,
+             prof.mappings[int(prof.loc_mapping_id[j]) - 1].end)
+            if prof.loc_mapping_id[j] else None,
+            bool(prof.loc_is_kernel[j]),
+        )
+        for j in range(prof.n_locations)
+    }
+    return {"pid": prof.pid, "stacks": stacks, "locs": locs}
+
+
+def assert_equivalent(a: list[PidProfile], b: list[PidProfile]):
+    assert [p.pid for p in a] == [p.pid for p in b]
+    for pa, pb in zip(a, b):
+        pa.check()
+        pb.check()
+        assert canonical(pa) == canonical(pb)
+
+
+def snap_dup_rows() -> WindowSnapshot:
+    """Two rows with the identical (pid, stack) must merge; one kernel tail."""
+    stacks = np.zeros((4, STACK_SLOTS), np.uint64)
+    stacks[0, :2] = [0x1100, 0x2200]
+    stacks[1, :2] = [0x1100, 0x2200]          # duplicate of row 0
+    stacks[2, :3] = [0x1100, 0x2200, KERNEL_ADDR_START + 0x40]
+    stacks[3, :2] = [0x9100, 0x9200]          # other pid
+    table = MappingTable(
+        pids=[7, 9],
+        starts=[0x1000, 0x9000],
+        ends=[0x3000, 0xA000],
+        offsets=[0x100, 0],
+        objs=[0, 0],
+        obj_paths=("/bin/a",),
+        obj_buildids=("aa" * 20,),
+    )
+    return WindowSnapshot(
+        pids=[7, 7, 7, 9], tids=[7, 8, 7, 9], counts=[3, 4, 2, 5],
+        user_len=[2, 2, 2, 2], kernel_len=[0, 0, 1, 0],
+        stacks=stacks, mappings=table,
+    )
+
+
+def test_dedup_and_normalize():
+    profs = CPUAggregator().aggregate(snap_dup_rows())
+    assert [p.pid for p in profs] == [7, 9]
+    p7 = profs[0]
+    c = canonical(p7)
+    assert c["stacks"][(0x1100, 0x2200)] == 7          # 3 + 4 merged
+    assert c["stacks"][(0x1100, 0x2200, KERNEL_ADDR_START + 0x40)] == 2
+    # normalized = addr - start + offset
+    assert c["locs"][0x1100][0] == 0x1100 - 0x1000 + 0x100
+    assert c["locs"][0x1100][1] == (0x1000, 0x3000)
+    kaddr = KERNEL_ADDR_START + 0x40
+    assert c["locs"][kaddr] == (kaddr, None, True)
+    assert p7.total() == 9
+    p9 = profs[1]
+    assert p9.total() == 5
+    assert canonical(p9)["locs"][0x9100][0] == 0x100
+
+
+def test_naive_matches_cpu_small():
+    assert_equivalent(
+        NaiveAggregator().aggregate(snap_dup_rows()),
+        CPUAggregator().aggregate(snap_dup_rows()),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_naive_matches_cpu_synthetic(seed):
+    snap = generate(
+        SyntheticSpec(n_pids=12, n_unique_stacks=120, total_samples=4000,
+                      kernel_fraction=0.4, seed=seed)
+    )
+    assert_equivalent(
+        NaiveAggregator().aggregate(snap), CPUAggregator().aggregate(snap)
+    )
+
+
+def test_counts_conserved():
+    snap = generate(SyntheticSpec(n_pids=30, n_unique_stacks=500, seed=9))
+    profs = CPUAggregator().aggregate(snap)
+    assert sum(p.total() for p in profs) == snap.total_samples()
+
+
+def test_empty_snapshot():
+    empty = WindowSnapshot(
+        pids=[], tids=[], counts=[], user_len=[], kernel_len=[],
+        stacks=np.zeros((0, STACK_SLOTS), np.uint64),
+        mappings=MappingTable.empty(),
+    )
+    assert CPUAggregator().aggregate(empty) == []
+    assert NaiveAggregator().aggregate(empty) == []
+
+
+def test_unmapped_address_kept_raw():
+    stacks = np.zeros((1, STACK_SLOTS), np.uint64)
+    stacks[0, :1] = [0xDEAD000]
+    snap = WindowSnapshot(
+        pids=[5], tids=[5], counts=[1], user_len=[1], kernel_len=[0],
+        stacks=stacks, mappings=MappingTable.empty(),
+    )
+    p = CPUAggregator().aggregate(snap)[0]
+    assert canonical(p)["locs"][0xDEAD000] == (0xDEAD000, None, False)

@@ -78,6 +78,21 @@ def test_mapping_sort_enforced():
         )
 
 
+def test_mapping_overlap_rejected():
+    with pytest.raises(ValueError, match="overlap"):
+        MappingTable(
+            pids=[1, 1], starts=[0x1000, 0x2000], ends=[0x3000, 0x4000],
+            offsets=[0, 0], objs=[0, 0],
+        )
+    with pytest.raises(ValueError, match="precedes"):
+        MappingTable(pids=[1], starts=[0x2000], ends=[0x1000], offsets=[0], objs=[0])
+    # different pids may reuse overlapping ranges (shared libraries do)
+    MappingTable(
+        pids=[1, 2], starts=[0x1000, 0x1000], ends=[0x3000, 0x3000],
+        offsets=[0, 0], objs=[0, 0],
+    )
+
+
 def test_bad_magic():
     with pytest.raises(ValueError):
         load_snapshot(io.BytesIO(b"NOTASNAP" + b"\x00" * 16))
@@ -91,7 +106,7 @@ def test_synthetic_deterministic_and_valid():
     assert np.array_equal(a.counts, b.counts)
     a.validate_padding()
     assert len(a) <= 200
-    assert a.total_samples() >= 5000 * 0.9
+    assert a.total_samples() == 5000
     # every user frame falls inside some mapping of its pid
     mt = a.mappings
     for i in range(min(len(a), 32)):

@@ -1,0 +1,88 @@
+import gzip
+
+import numpy as np
+
+from parca_agent_tpu.aggregator import CPUAggregator
+from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+from parca_agent_tpu.pprof import build_pprof, parse_pprof
+from parca_agent_tpu.pprof import proto
+
+
+def test_varint_roundtrip():
+    for v in [0, 1, 127, 128, 300, (1 << 32), (1 << 64) - 1, -1, -123456]:
+        buf = bytearray()
+        proto.put_varint(buf, v)
+        got, pos = proto.get_varint(bytes(buf), 0)
+        assert pos == len(buf)
+        assert got == (v & ((1 << 64) - 1))
+        if v < 0:
+            assert proto.signed(got) == v
+
+
+def test_pprof_roundtrip_synthetic():
+    snap = generate(SyntheticSpec(n_pids=5, n_unique_stacks=60, total_samples=2000, seed=4))
+    profs = CPUAggregator().aggregate(snap)
+    prof = profs[0]
+    data = build_pprof(prof, labels={"node": "n1", "__name__": "cpu"})
+    assert data[:2] == b"\x1f\x8b"  # gzipped
+    parsed = parse_pprof(data)
+
+    assert parsed.sample_types == [("samples", "count")]
+    assert parsed.period_type == ("cpu", "nanoseconds")
+    assert parsed.period == snap.period_ns
+    assert parsed.duration_nanos == snap.window_ns
+    assert parsed.time_nanos == snap.time_ns
+    assert len(parsed.samples) == prof.n_samples
+    assert len(parsed.locations) == prof.n_locations
+    assert len(parsed.mappings) == len(prof.mappings)
+    # counts conserved through encode/parse
+    assert sum(v[0] for _, v, _ in parsed.samples) == prof.total()
+    # labels on every sample
+    for _, _, labels in parsed.samples:
+        assert labels == {"node": "n1", "__name__": "cpu"}
+    # every sample's location ids resolve
+    for loc_ids, _, _ in parsed.samples:
+        for lid in loc_ids:
+            assert lid in parsed.locations
+    # normalized addresses surface on locations
+    addr_set = {loc["address"] for loc in parsed.locations.values()}
+    assert addr_set == {int(a) for a in prof.loc_normalized}
+    # mapping metadata carried through
+    m1 = parsed.mappings[1]
+    assert m1["filename"] == prof.mappings[0].path
+    assert m1["build_id"] == prof.mappings[0].build_id
+
+
+def test_pprof_uncompressed_and_stack_totals():
+    snap = generate(SyntheticSpec(n_pids=3, n_unique_stacks=20, total_samples=300, seed=8))
+    prof = CPUAggregator().aggregate(snap)[0]
+    raw = build_pprof(prof, compress=False)
+    assert raw[:2] != b"\x1f\x8b"
+    parsed = parse_pprof(raw)
+    # by-address stack totals match the profile tables
+    want = {}
+    for i in range(prof.n_samples):
+        d = int(prof.stack_depths[i])
+        key = tuple(
+            int(prof.loc_normalized[prof.stack_loc_ids[i, j] - 1]) for j in range(d)
+        )
+        want[key] = want.get(key, 0) + int(prof.values[i])
+    assert parsed.stacks_by_address() == want
+
+
+def test_functions_and_lines_encode():
+    snap = generate(SyntheticSpec(n_pids=2, n_unique_stacks=10, total_samples=100, seed=2))
+    prof = CPUAggregator().aggregate(snap)[0]
+    prof.functions = [("main", "main", "/src/main.c", 10)]
+    prof.loc_lines = [[(1, 42)] if j == 0 else [] for j in range(prof.n_locations)]
+    parsed = parse_pprof(build_pprof(prof))
+    assert parsed.functions[1]["name"] == "main"
+    assert parsed.functions[1]["filename"] == "/src/main.c"
+    assert parsed.locations[1]["lines"] == [(1, 42)]
+
+
+def test_gzip_member_is_standard():
+    snap = generate(SyntheticSpec(n_pids=2, n_unique_stacks=10, total_samples=100, seed=2))
+    prof = CPUAggregator().aggregate(snap)[0]
+    data = build_pprof(prof)
+    gzip.decompress(data)  # must be a plain gzip member
