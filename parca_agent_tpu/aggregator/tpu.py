@@ -1,0 +1,382 @@
+"""Device (TPU/XLA) aggregation backend — the flagship kernel.
+
+Re-expresses the reference's per-interval profile build (the `obtainProfiles`
+hot loop, reference pkg/profiler/cpu/cpu.go:505-718) as ONE jit-compiled XLA
+program batched over all PIDs at once:
+
+  1. row hash      — two independent multilinear hashes over the padded
+                     stack row (pid, user_len, kernel_len, 128 frames);
+  2. stack dedup   — `lax.sort` by (pid, h1, h2), then FULL row comparison
+                     between neighbors (a hash collision can therefore never
+                     merge two distinct stacks), `segment_sum` of counts;
+  3. location dedup— flatten live frames of the unique stacks, sort by
+                     (pid, addr_hi, addr_lo), boundary-scan to per-PID
+                     1-based location ids, scatter-compact the unique
+                     locations into a bounded [L_cap] table (the same
+                     bounded-memory role the reference's 250k-row unwind
+                     shards play, reference pkg/profiler/cpu/maps.go:40-43);
+  4. mapping join  — branchless vectorized binary search of every unique
+                     location against the (pid, start)-sorted mapping table
+                     (the data-parallel analog of `find_offset_for_pc`,
+                     reference bpf/cpu/cpu.bpf.c:302-341).
+
+Addresses travel as (hi, lo) uint32 pairs — TPUs have no native 64-bit
+integer datapath, and JAX x64 stays off. The host wrapper does only what
+cannot or should not live on device: u64 normalization arithmetic
+(addr - start + offset), per-PID profile splitting, and string tables.
+
+Shapes are static per (N_pad, M_pad, L_cap) bucket so recompilation stops
+after the first few windows; N is padded to the next power of two.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import numpy as np
+
+from parca_agent_tpu.aggregator.base import PidProfile
+from parca_agent_tpu.aggregator.cpu import _pid_mappings
+from parca_agent_tpu.capture.formats import (
+    KERNEL_ADDR_START,
+    STACK_SLOTS,
+    MappingTable,
+    WindowSnapshot,
+)
+from parca_agent_tpu.ops.hashing import fold_u64_rows, multilinear_hash_u32
+
+_U32_MAX = 0xFFFFFFFF
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def _shift_down(a, fill):
+    """[a0, a1, ...] -> [fill, a0, a1, ...] dropping the last element."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate([jnp.full(a.shape[:0] + (1,), fill, a.dtype), a[:-1]])
+
+
+def _lex_le3(a1, a2, a3, b1, b2, b3):
+    """(a1,a2,a3) <= (b1,b2,b3) lexicographically, elementwise uint32."""
+    return (a1 < b1) | ((a1 == b1) & ((a2 < b2) | ((a2 == b2) & (a3 <= b3))))
+
+
+@functools.lru_cache(maxsize=1)
+def _jitted_kernel():
+    import jax
+
+    return jax.jit(_window_kernel, static_argnames=("n_pad", "l_cap", "m_pad"))
+
+
+def _window_kernel(
+    pid,        # uint32 [N]   (padding rows = U32_MAX)
+    cnt,        # int32  [N]   (padding rows = 0)
+    ulen,       # int32  [N]
+    klen,       # int32  [N]
+    shi,        # uint32 [N,S] stack address high halves
+    slo,        # uint32 [N,S] stack address low halves
+    valid,      # bool   [N]
+    map_pid,    # uint32 [M]   (padding rows = U32_MAX)
+    map_shi,    # uint32 [M]   mapping start hi
+    map_slo,    # uint32 [M]   mapping start lo
+    map_ehi,    # uint32 [M]   mapping end hi
+    map_elo,    # uint32 [M]   mapping end lo
+    *,
+    n_pad: int,
+    l_cap: int,
+    m_pad: int,
+):
+    import jax
+    import jax.numpy as jnp
+
+    n, s = shi.shape
+
+    # ---- 1. row hash ------------------------------------------------------
+    lanes = fold_u64_rows(
+        shi, slo, extra=[pid, ulen.astype(jnp.uint32), klen.astype(jnp.uint32)]
+    )
+    h1 = multilinear_hash_u32(lanes, 0)
+    h2 = multilinear_hash_u32(lanes, 1)
+
+    # ---- 2. exact stack dedup --------------------------------------------
+    pid_s, h1_s, h2_s, perm = jax.lax.sort(
+        (pid, h1, h2, jnp.arange(n, dtype=jnp.int32)), num_keys=3, is_stable=True
+    )
+    cnt_s = cnt[perm]
+    ulen_s = ulen[perm]
+    klen_s = klen[perm]
+    shi_s = shi[perm]
+    slo_s = slo[perm]
+    valid_s = valid[perm]
+
+    same_meta = (
+        (pid_s == _shift_down(pid_s, jnp.uint32(_U32_MAX)))
+        & (ulen_s == _shift_down(ulen_s, jnp.int32(-1)))
+        & (klen_s == _shift_down(klen_s, jnp.int32(-1)))
+    )
+    same_stack = jnp.all(
+        (shi_s == jnp.concatenate([shi_s[:1], shi_s[:-1]]))
+        & (slo_s == jnp.concatenate([slo_s[:1], slo_s[:-1]])),
+        axis=1,
+    )
+    same_stack = same_stack.at[0].set(False)
+    new_group = (~(same_meta & same_stack)) & valid_s
+    new_group = new_group.at[0].set(valid_s[0])
+
+    group = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    group = jnp.maximum(group, 0)
+    n_groups = new_group.astype(jnp.int32).sum()
+
+    values = jax.ops.segment_sum(cnt_s, group, num_segments=n_pad)
+    rep_pos = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), group, num_segments=n_pad
+    )
+    rep_pos = jnp.minimum(rep_pos, n - 1)  # padded groups -> harmless gather
+
+    out_pid = pid_s[rep_pos]
+    out_ulen = ulen_s[rep_pos]
+    out_klen = klen_s[rep_pos]
+    out_shi = shi_s[rep_pos]
+    out_slo = slo_s[rep_pos]
+    group_live = jnp.arange(n, dtype=jnp.int32) < n_groups
+
+    # ---- 3. location dedup ------------------------------------------------
+    depth = out_ulen + out_klen
+    slot = jnp.arange(s, dtype=jnp.int32)[None, :]
+    frame_live = (slot < depth[:, None]) & group_live[:, None]
+
+    fpid = jnp.where(frame_live, out_pid[:, None], jnp.uint32(_U32_MAX)).reshape(-1)
+    fhi = jnp.where(frame_live, out_shi, jnp.uint32(_U32_MAX)).reshape(-1)
+    flo = jnp.where(frame_live, out_slo, jnp.uint32(_U32_MAX)).reshape(-1)
+    flive = frame_live.reshape(-1)
+
+    fpid_s, fhi_s, flo_s, flive_s, fidx = jax.lax.sort(
+        (fpid, fhi, flo, flive, jnp.arange(n * s, dtype=jnp.int32)),
+        num_keys=3,
+        is_stable=True,
+    )
+
+    same_loc = (
+        (fpid_s == _shift_down(fpid_s, jnp.uint32(_U32_MAX)))
+        & (fhi_s == _shift_down(fhi_s, jnp.uint32(0)))
+        & (flo_s == _shift_down(flo_s, jnp.uint32(0)))
+    )
+    same_loc = same_loc.at[0].set(False)
+    new_loc = (~same_loc) & flive_s
+    new_loc = new_loc.at[0].set(flive_s[0])
+    n_locs = new_loc.astype(jnp.int32).sum()
+
+    # Global 1-based location sequence number, constant within a loc group.
+    loc_seq = jnp.cumsum(new_loc.astype(jnp.int32))
+
+    # First loc sequence number within each pid segment -> per-pid rank.
+    new_pid = (fpid_s != _shift_down(fpid_s, jnp.uint32(_U32_MAX))) & flive_s
+    new_pid = new_pid.at[0].set(flive_s[0])
+    pid_seg = jnp.maximum(jnp.cumsum(new_pid.astype(jnp.int32)) - 1, 0)
+    pid_first_seq = jax.ops.segment_min(
+        jnp.where(flive_s, loc_seq, jnp.int32(2**31 - 1)),
+        pid_seg,
+        num_segments=n_pad,
+    )
+    rank = jnp.where(flive_s, loc_seq - pid_first_seq[pid_seg] + 1, 0)
+
+    # Scatter per-frame ranks back to representative-row layout [N, S].
+    loc_ids = (
+        jnp.zeros((n * s,), jnp.int32).at[fidx].set(rank).reshape(n, s)
+    )
+
+    # Compact the unique locations into the bounded [L_cap] table.
+    tgt = jnp.where(new_loc, loc_seq - 1, jnp.int32(l_cap))
+    loc_pid = (
+        jnp.full((l_cap,), _U32_MAX, jnp.uint32).at[tgt].set(fpid_s, mode="drop")
+    )
+    loc_hi = jnp.zeros((l_cap,), jnp.uint32).at[tgt].set(fhi_s, mode="drop")
+    loc_lo = jnp.zeros((l_cap,), jnp.uint32).at[tgt].set(flo_s, mode="drop")
+
+    # ---- 4. mapping join --------------------------------------------------
+    # rank_le[q] = number of mapping rows with key <= (pid, addr); candidate
+    # row = rank_le - 1. Branchless binary search, all queries in lockstep.
+    steps = max(1, math.ceil(math.log2(m_pad + 1)))
+
+    def body(_, lohi):
+        lo_b, hi_b = lohi
+        cont = lo_b < hi_b
+        mid = jnp.minimum((lo_b + hi_b) // 2, m_pad - 1)
+        le = _lex_le3(
+            map_pid[mid], map_shi[mid], map_slo[mid], loc_pid, loc_hi, loc_lo
+        )
+        new_lo = jnp.where(le, mid + 1, lo_b)
+        new_hi = jnp.where(le, hi_b, mid)
+        return jnp.where(cont, new_lo, lo_b), jnp.where(cont, new_hi, hi_b)
+
+    lo_b = jnp.zeros((l_cap,), jnp.int32)
+    hi_b = jnp.full((l_cap,), m_pad, jnp.int32)
+    lo_b, hi_b = jax.lax.fori_loop(0, steps, body, (lo_b, hi_b))
+    cand = lo_b - 1
+    safe = jnp.maximum(cand, 0)
+    addr_lt_end = (loc_hi < map_ehi[safe]) | (
+        (loc_hi == map_ehi[safe]) & (loc_lo < map_elo[safe])
+    )
+    hit = (cand >= 0) & (map_pid[safe] == loc_pid) & addr_lt_end
+    loc_map_row = jnp.where(hit, safe, jnp.int32(-1))
+
+    return (
+        n_groups,
+        n_locs,
+        out_pid,
+        depth,
+        values,
+        loc_ids,
+        loc_pid,
+        loc_hi,
+        loc_lo,
+        loc_map_row,
+    )
+
+
+@dataclasses.dataclass
+class TPUAggregator:
+    """Aggregation backend running the window kernel on the default JAX
+    backend (TPU in production; CPU in tests via JAX_PLATFORMS=cpu).
+
+    The unique-location table is a bounded buffer: the first attempt sizes
+    it at next_pow2(total_live_frames / 4) — profiling windows dedup far
+    below their frame count — and if the kernel reports n_locs above the
+    cap, the window is re-run with the cap doubled. Results are therefore
+    always exact; the cap bounds memory, it never truncates.
+    """
+
+    name: str = "tpu"
+
+    def aggregate(self, snapshot: WindowSnapshot) -> list[PidProfile]:
+        import jax.numpy as jnp
+
+        n = len(snapshot)
+        if n == 0:
+            return []
+        # Counts ride int32 lanes on device; guard the whole window's total
+        # (an upper bound on any merged group's sum) rather than per-row.
+        if int(snapshot.counts.sum()) >= 2**31:
+            raise ValueError("window sample total exceeds int32")
+
+        n_pad = _next_pow2(n)
+        table = snapshot.mappings
+        m = len(table)
+        m_pad = max(1, _next_pow2(m))
+
+        pid = np.full(n_pad, _U32_MAX, np.uint32)
+        pid[:n] = snapshot.pids.astype(np.uint32)
+        cnt = np.zeros(n_pad, np.int32)
+        cnt[:n] = snapshot.counts.astype(np.int32)
+        ulen = np.zeros(n_pad, np.int32)
+        ulen[:n] = snapshot.user_len
+        klen = np.zeros(n_pad, np.int32)
+        klen[:n] = snapshot.kernel_len
+        shi = np.zeros((n_pad, STACK_SLOTS), np.uint32)
+        slo = np.zeros((n_pad, STACK_SLOTS), np.uint32)
+        shi[:n] = (snapshot.stacks >> np.uint64(32)).astype(np.uint32)
+        slo[:n] = snapshot.stacks.astype(np.uint32)
+        valid = np.zeros(n_pad, bool)
+        valid[:n] = True
+
+        map_pid = np.full(m_pad, _U32_MAX, np.uint32)
+        map_shi = np.full(m_pad, _U32_MAX, np.uint32)
+        map_slo = np.full(m_pad, _U32_MAX, np.uint32)
+        map_ehi = np.zeros(m_pad, np.uint32)
+        map_elo = np.zeros(m_pad, np.uint32)
+        map_pid[:m] = table.pids.astype(np.uint32)
+        map_shi[:m] = (table.starts >> np.uint64(32)).astype(np.uint32)
+        map_slo[:m] = table.starts.astype(np.uint32)
+        map_ehi[:m] = (table.ends >> np.uint64(32)).astype(np.uint32)
+        map_elo[:m] = table.ends.astype(np.uint32)
+
+        total_frames = int((snapshot.user_len + snapshot.kernel_len).sum())
+        l_cap = max(16, _next_pow2(max(1, total_frames // 4)))
+
+        while True:
+            out = _jitted_kernel()(
+                jnp.asarray(pid), jnp.asarray(cnt), jnp.asarray(ulen),
+                jnp.asarray(klen), jnp.asarray(shi), jnp.asarray(slo),
+                jnp.asarray(valid), jnp.asarray(map_pid), jnp.asarray(map_shi),
+                jnp.asarray(map_slo), jnp.asarray(map_ehi), jnp.asarray(map_elo),
+                n_pad=n_pad, l_cap=l_cap, m_pad=m_pad,
+            )
+            (n_groups, n_locs, out_pid, depth, values, loc_ids,
+             loc_pid, loc_hi, loc_lo, loc_map_row) = map(np.asarray, out)
+            if int(n_locs) <= l_cap:
+                break
+            l_cap *= 2
+
+        return self._build_profiles(
+            snapshot, table,
+            int(n_groups), int(n_locs), out_pid, depth, values, loc_ids,
+            loc_pid, loc_hi, loc_lo, loc_map_row,
+        )
+
+    def _build_profiles(
+        self, snapshot, table, n_groups, n_locs, out_pid, depth, values,
+        loc_ids, loc_pid, loc_hi, loc_lo, loc_map_row,
+    ) -> list[PidProfile]:
+        u_pid = out_pid[:n_groups].astype(np.int64)
+        u_depth = depth[:n_groups].astype(np.int32)
+        u_values = values[:n_groups].astype(np.int64)
+        u_loc_ids = loc_ids[:n_groups]
+
+        l_pid = loc_pid[:n_locs].astype(np.int64)
+        l_addr = (loc_hi[:n_locs].astype(np.uint64) << np.uint64(32)) | loc_lo[
+            :n_locs
+        ].astype(np.uint64)
+        l_row = loc_map_row[:n_locs]
+
+        l_kernel = l_addr >= np.uint64(KERNEL_ADDR_START)
+        # u64 arithmetic + per-pid mapping ranks stay on host. Kernel text
+        # is never normalized through the mapping table, even if a mapping
+        # (e.g. [vsyscall]) covers it — matches the CPU oracle and the
+        # formats.py contract.
+        hit = (l_row >= 0) & ~l_kernel
+        safe = np.maximum(l_row, 0)
+        if len(table):
+            l_norm = np.where(
+                hit, l_addr - table.starts[safe] + table.offsets[safe], l_addr
+            )
+            # Global mapping row -> 1-based rank within its pid (rows are
+            # sorted by (pid, start): rank = row - first row of pid's block).
+            pid_first_row = np.searchsorted(table.pids, table.pids[safe], "left")
+            l_map_id = np.where(hit, safe - pid_first_row + 1, 0).astype(np.int32)
+        else:
+            l_norm = l_addr.copy()
+            l_map_id = np.zeros(n_locs, np.int32)
+
+        # Both tables arrive pid-contiguous (device sort order); split them.
+        profiles: list[PidProfile] = []
+        stack_bounds = np.flatnonzero(np.diff(u_pid)) + 1
+        s_starts = np.concatenate(([0], stack_bounds))
+        s_ends = np.concatenate((stack_bounds, [n_groups]))
+        loc_starts = np.searchsorted(l_pid, u_pid[s_starts], "left")
+        loc_ends = np.searchsorted(l_pid, u_pid[s_starts], "right")
+
+        for i, (lo, hi) in enumerate(zip(s_starts, s_ends)):
+            pid = int(u_pid[lo])
+            llo, lhi = int(loc_starts[i]), int(loc_ends[i])
+            profiles.append(
+                PidProfile(
+                    pid=pid,
+                    stack_loc_ids=u_loc_ids[lo:hi],
+                    stack_depths=u_depth[lo:hi],
+                    values=u_values[lo:hi],
+                    loc_address=l_addr[llo:lhi],
+                    loc_normalized=l_norm[llo:lhi].astype(np.uint64),
+                    loc_mapping_id=l_map_id[llo:lhi],
+                    loc_is_kernel=l_kernel[llo:lhi],
+                    mappings=_pid_mappings(table, pid),
+                    period_ns=snapshot.period_ns,
+                    time_ns=snapshot.time_ns,
+                    duration_ns=snapshot.window_ns,
+                )
+            )
+        return profiles

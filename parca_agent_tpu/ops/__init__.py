@@ -1,0 +1,15 @@
+"""Device-side primitive ops shared by aggregation backends and sketches."""
+
+from parca_agent_tpu.ops.hashing import (
+    fold_u64_rows,
+    mix32,
+    multilinear_hash_u32,
+    row_hash_np,
+)
+
+__all__ = [
+    "fold_u64_rows",
+    "mix32",
+    "multilinear_hash_u32",
+    "row_hash_np",
+]

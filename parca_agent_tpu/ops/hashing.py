@@ -1,0 +1,101 @@
+"""Hashing primitives for the device aggregation path.
+
+Everything here works on uint32 lanes because TPUs have no native 64-bit
+integer datapath (JAX runs with x64 disabled); 64-bit addresses travel as
+(hi, lo) uint32 pairs. The workhorse is a multilinear hash family
+h(x) = b + sum_i a_i * x_i (mod 2^32) with fixed random odd coefficients:
+pairwise collision probability <= 2^-32 per independent hash, fully
+vectorizable as a multiply + lane reduction, which XLA fuses into the
+surrounding sort pipeline.
+
+The role MurmurHash2 plays on the reference capture side (hashing the
+127-slot DWARF stack buffer into a stack id, reference bpf/cpu/cpu.bpf.c:
+438-448 and bpf/cpu/hash.h:6) is played here by two independent multilinear
+hashes over the padded stack row; unlike the reference we never trust the
+hash alone — the dedup pipeline compares full rows before merging.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Fixed seed: hashes must be stable across processes so fleet-merged sketches
+# built on different hosts agree bucket-for-bucket.
+_COEF_RNG = np.random.default_rng(0x9E3779B9)
+# Enough coefficient lanes for [hi | lo | pid | user_len | kernel_len].
+_MAX_LANES = 2 * 128 + 8
+# Odd coefficients make x -> a*x a bijection mod 2^32.
+_COEFS = (
+    _COEF_RNG.integers(0, 1 << 32, size=(2, _MAX_LANES), dtype=np.uint64).astype(
+        np.uint32
+    )
+    | np.uint32(1)
+)
+_BIASES = _COEF_RNG.integers(0, 1 << 32, size=2, dtype=np.uint64).astype(np.uint32)
+
+
+def _np_or_jnp(x):
+    return np if isinstance(x, np.ndarray) else _jnp()
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def mix32(x, seed: int = 0):
+    """fmix32 finalizer (murmur3-style): avalanche a uint32 lane."""
+    xp = _np_or_jnp(x)
+    x = x.astype(xp.uint32) ^ xp.uint32(seed & 0xFFFFFFFF)
+    x = x ^ (x >> xp.uint32(16))
+    x = x * xp.uint32(0x85EBCA6B)
+    x = x ^ (x >> xp.uint32(13))
+    x = x * xp.uint32(0xC2B2AE35)
+    x = x ^ (x >> xp.uint32(16))
+    return x
+
+
+def multilinear_hash_u32(lanes, which: int):
+    """Hash uint32 lane matrix [N, K] -> uint32 [N] with hash family `which`.
+
+    Modular arithmetic wraps naturally in uint32; the final mix decorrelates
+    the low bits so the result can be truncated for sketch bucket indices.
+    """
+    xp = _np_or_jnp(lanes)
+    k = lanes.shape[-1]
+    if k > _MAX_LANES:
+        raise ValueError(f"too many lanes to hash: {k} > {_MAX_LANES}")
+    coefs = xp.asarray(_COEFS[which, :k])
+    acc = (lanes.astype(xp.uint32) * coefs[None, :]).sum(axis=-1, dtype=xp.uint32)
+    return mix32(acc + xp.asarray(_BIASES[which]))
+
+
+def fold_u64_rows(hi, lo, extra=None):
+    """Interleave (hi, lo) uint32 matrices [N, S] (+ optional scalar columns
+    [N] each) into one lane matrix for multilinear_hash_u32."""
+    xp = _np_or_jnp(hi)
+    cols = [hi.astype(xp.uint32), lo.astype(xp.uint32)]
+    if extra:
+        cols.append(xp.stack([c.astype(xp.uint32) for c in extra], axis=-1))
+    return xp.concatenate(cols, axis=-1)
+
+
+def row_hash_np(stacks_u64: np.ndarray, pids, user_len, kernel_len):
+    """Host-side (numpy) twin of the device row hash; used by sketches and
+    tests to confirm host/device hash agreement."""
+    hi = (stacks_u64 >> np.uint64(32)).astype(np.uint32)
+    lo = stacks_u64.astype(np.uint32)
+    lanes = fold_u64_rows(
+        hi,
+        lo,
+        extra=[
+            np.asarray(pids, np.uint32),
+            np.asarray(user_len, np.uint32),
+            np.asarray(kernel_len, np.uint32),
+        ],
+    )
+    return (
+        multilinear_hash_u32(lanes, 0),
+        multilinear_hash_u32(lanes, 1),
+    )

@@ -18,12 +18,11 @@ from parca_agent_tpu.pprof import proto
 # profile.proto field numbers (public schema).
 P_SAMPLE_TYPE, P_SAMPLE, P_MAPPING, P_LOCATION, P_FUNCTION = 1, 2, 3, 4, 5
 P_STRING_TABLE, P_TIME_NANOS, P_DURATION_NANOS = 6, 9, 10
-P_PERIOD_TYPE, P_PERIOD, P_DEFAULT_SAMPLE_TYPE = 11, 12, 14
+P_PERIOD_TYPE, P_PERIOD = 11, 12
 VT_TYPE, VT_UNIT = 1, 2
 S_LOCATION_ID, S_VALUE, S_LABEL = 1, 2, 3
 L_KEY, L_STR, L_NUM = 1, 2, 3
 M_ID, M_START, M_LIMIT, M_OFFSET, M_FILENAME, M_BUILDID = 1, 2, 3, 4, 5, 6
-M_HAS_FUNCTIONS = 7
 LOC_ID, LOC_MAPPING_ID, LOC_ADDRESS, LOC_LINE = 1, 2, 3, 4
 LINE_FUNCTION_ID, LINE_LINE = 1, 2
 F_ID, F_NAME, F_SYSTEM_NAME, F_FILENAME, F_START_LINE = 1, 2, 3, 4, 5

@@ -8,7 +8,6 @@ contract of the reference's output, pkg/profiler/pprof.go).
 
 from __future__ import annotations
 
-import io
 from typing import Iterator
 
 
@@ -61,10 +60,6 @@ def put_tag_bytes(out: bytearray, field: int, data: bytes) -> None:
     put_varint(out, tag(field, 2))
     put_varint(out, len(data))
     out.extend(data)
-
-
-def put_tag_str(out: bytearray, field: int, s: str) -> None:
-    put_tag_bytes(out, field, s.encode())
 
 
 def put_packed(out: bytearray, field: int, values) -> None:
@@ -133,21 +128,6 @@ class Writer:
 
     def varint(self, field: int, v: int) -> "Writer":
         put_tag_varint(self.buf, field, v)
-        return self
-
-    def raw_varint(self, field: int, v: int) -> "Writer":
-        # Emit even when zero (for required-in-practice ids).
-        put_varint(self.buf, tag(field, 0))
-        put_varint(self.buf, v)
-        return self
-
-    def string(self, field: int, s: str) -> "Writer":
-        if s:
-            put_tag_str(self.buf, field, s)
-        return self
-
-    def bytes_field(self, field: int, b: bytes) -> "Writer":
-        put_tag_bytes(self.buf, field, b)
         return self
 
     def message(self, field: int, body: bytes | bytearray) -> "Writer":

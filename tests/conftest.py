@@ -2,14 +2,22 @@
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 tested on virtual CPU devices per SURVEY.md section 4's closing note.
-Must run before anything imports jax.
+
+The ambient environment may have already registered a real TPU backend via
+sitecustomize (and forced jax_platforms to it) before this file runs, so
+env vars alone don't cut it: override the live jax config. This must happen
+before any JAX computation initializes a backend.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
