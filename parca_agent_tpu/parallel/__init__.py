@@ -1,0 +1,22 @@
+"""Distributed fleet merge over a TPU device mesh.
+
+The reference is a single-node daemon with no collective backend (SURVEY.md
+section 2.12); its only cross-machine channel is application-level gRPC to
+the Parca server. The TPU-native equivalent built here: per-node window
+sketches reduced over ICI/DCN with XLA collectives inside one shard_map
+program (BASELINE config #5).
+"""
+
+from parca_agent_tpu.parallel.fleet import (
+    FleetMergeSpec,
+    fleet_merge_sketches,
+    fleet_merge_exact,
+)
+from parca_agent_tpu.parallel.mesh import fleet_mesh
+
+__all__ = [
+    "FleetMergeSpec",
+    "fleet_merge_sketches",
+    "fleet_merge_exact",
+    "fleet_mesh",
+]

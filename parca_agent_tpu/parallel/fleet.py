@@ -1,0 +1,176 @@
+"""Fleet merge: N nodes' window summaries -> one cluster-wide view.
+
+BASELINE config #5: "8-node fleet merge: per-node sketches psum'd over ICI
+into one cluster-wide pprof". Two paths, both single shard_map programs over
+the "node" mesh axis:
+
+  fleet_merge_sketches — each node builds a count-min table and HLL register
+      file from its local (stack-hash, count) stream; one `psum` merges the
+      count-min tables (linear), one `pmax` the HLL registers (idempotent).
+      Communication is O(sketch), independent of window size — the
+      bounded-bandwidth mode for big fleets.
+
+  fleet_merge_exact — `all_gather` every node's (hash, count) rows, then one
+      global sort + segment-sum dedups identical stacks across nodes.
+      Communication is O(total rows); exact, for small fleets/windows and as
+      the correctness oracle for the sketch path.
+
+Row liveness is `count > 0`: capture maps never hold zero-count entries, so
+padding (and a dead node's entire shard — SURVEY.md section 5.3 requires the
+merge to tolerate missing nodes) is simply zero counts, which is the
+identity for every reduction used here. PAD_HASH is only the conventional
+filler value for the hash column of padding rows; a real row whose hash
+happens to equal it is still counted.
+
+Per-node inputs are fixed-width [R] shards stacked to [n_nodes, R]; rows are
+(uint32 stack hash, int32 count) — the compacted stream the aggregator
+already produces, not raw 128-slot stacks, per SURVEY.md section 7 hard
+part #3 (ship compacted streams, not raw addresses).
+
+Device counts ride int32 lanes (no x64 on TPU): per-node window totals are
+guarded < 2^31 upstream (TPUAggregator.aggregate), per-node totals are
+returned unsummed and added in int64 on the host, and merged count-min
+cells are checked non-negative — `fleet_size * window_total` must stay
+below 2^31 for the count-min merge, and violations raise instead of
+wrapping silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from parca_agent_tpu.ops.sketch import (
+    CountMinSpec,
+    HLLSpec,
+    cm_build,
+    hll_build,
+)
+from parca_agent_tpu.parallel.mesh import FLEET_AXIS, fleet_mesh
+
+# Conventional hash filler for padding rows (liveness is count > 0).
+PAD_HASH = 0xFFFFFFFF
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetMergeSpec:
+    cm: CountMinSpec = CountMinSpec()
+    hll: HLLSpec = HLLSpec()
+
+
+@functools.lru_cache(maxsize=8)
+def _sketch_program(mesh, spec: FleetMergeSpec):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def node_fn(hashes, counts):
+        # [1, R] shard per node inside shard_map.
+        h = hashes[0]
+        c = counts[0]
+        cm = cm_build(h, c, spec.cm)  # zero-count rows add nothing
+        regs = hll_build(h, spec.hll, live=c > 0)
+        total = c.sum()
+        cm = jax.lax.psum(cm, FLEET_AXIS)
+        regs = jax.lax.pmax(regs, FLEET_AXIS)
+        return cm[None], regs[None], total[None]
+
+    fn = jax.shard_map(
+        node_fn,
+        mesh=mesh,
+        in_specs=(P(FLEET_AXIS, None), P(FLEET_AXIS, None)),
+        out_specs=(P(FLEET_AXIS, None, None), P(FLEET_AXIS, None), P(FLEET_AXIS)),
+    )
+    return jax.jit(fn)
+
+
+def _check_streams(node_hashes, node_counts):
+    node_hashes = np.asarray(node_hashes, np.uint32)
+    node_counts = np.asarray(node_counts, np.int32)
+    if node_hashes.shape != node_counts.shape or node_hashes.ndim != 2:
+        raise ValueError("node streams must be [n_nodes, R] and congruent")
+    if np.any(node_counts < 0):
+        raise ValueError("negative row count")
+    return node_hashes, node_counts
+
+
+def fleet_merge_sketches(node_hashes, node_counts, spec=FleetMergeSpec(), mesh=None):
+    """Merge per-node streams into cluster-wide sketches.
+
+    node_hashes uint32 [n_nodes, R], node_counts int32 [n_nodes, R];
+    padding rows have count 0. Returns (cm_table [d, w], hll_regs [m],
+    total_samples int).
+    """
+    import jax.numpy as jnp
+
+    node_hashes, node_counts = _check_streams(node_hashes, node_counts)
+    if mesh is None:
+        mesh = fleet_mesh(node_hashes.shape[0])
+    prog = _sketch_program(mesh, spec)
+    cm, regs, totals = prog(jnp.asarray(node_hashes), jnp.asarray(node_counts))
+    cm = np.asarray(cm[0])
+    if np.any(cm < 0):
+        raise OverflowError(
+            "count-min cell wrapped int32: fleet total exceeds 2^31; "
+            "shard the fleet or shorten the window"
+        )
+    # Per-node totals summed on host in int64 (device lanes are int32).
+    total = int(np.asarray(totals).astype(np.int64).sum())
+    return cm, np.asarray(regs[0]), total
+
+
+@functools.lru_cache(maxsize=8)
+def _exact_program(mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def node_fn(hashes, counts):
+        h = hashes[0]
+        c = counts[0]
+        # Gather all nodes' rows; identical on every node afterwards.
+        all_h = jax.lax.all_gather(h, FLEET_AXIS).reshape(-1)
+        all_c = jax.lax.all_gather(c, FLEET_AXIS).reshape(-1)
+        n = all_h.shape[0]
+        # Sort by (hash, count) so each group's zero-count padding rows come
+        # first and every live row of a group is contiguous either way.
+        h_s, c_s = jax.lax.sort((all_h, all_c), num_keys=1, is_stable=False)
+        first = jnp.concatenate([jnp.ones((1,), bool), h_s[1:] != h_s[:-1]])
+        group = jnp.cumsum(first.astype(jnp.int32)) - 1
+        sums = jax.ops.segment_sum(c_s, group, num_segments=n)
+        # All rows in a group share the hash; no masking needed for reps.
+        reps = jax.ops.segment_max(h_s, group, num_segments=n)
+        n_groups = first.astype(jnp.int32).sum()
+        return reps[None], sums[None], n_groups[None]
+
+    fn = jax.shard_map(
+        node_fn,
+        mesh=mesh,
+        in_specs=(P(FLEET_AXIS, None), P(FLEET_AXIS, None)),
+        out_specs=(P(FLEET_AXIS, None), P(FLEET_AXIS, None), P(FLEET_AXIS)),
+    )
+    return jax.jit(fn)
+
+
+def fleet_merge_exact(node_hashes, node_counts, mesh=None):
+    """Exact cross-node dedup: returns (unique_hashes [U], counts [U]) for
+    rows with nonzero merged count.
+
+    Communication: one all_gather of every node's rows; the sort+segment-sum
+    runs redundantly on each node (cheap at these sizes, keeps the program
+    collective-simple).
+    """
+    import jax.numpy as jnp
+
+    node_hashes, node_counts = _check_streams(node_hashes, node_counts)
+    if mesh is None:
+        mesh = fleet_mesh(node_hashes.shape[0])
+    prog = _exact_program(mesh)
+    reps, sums, n_groups = prog(jnp.asarray(node_hashes), jnp.asarray(node_counts))
+    k = int(np.asarray(n_groups)[0])
+    uh = np.asarray(reps[0][:k])
+    uc = np.asarray(sums[0][:k])
+    # Padding-only groups merge to count 0; real rows always count >= 1.
+    live = uc > 0
+    return uh[live], uc[live]

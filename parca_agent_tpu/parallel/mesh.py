@@ -1,0 +1,30 @@
+"""Device-mesh construction for the fleet-merge path.
+
+One logical axis, "node": each mesh position plays the role one parca-agent
+daemon plays in the reference's deployment (a DaemonSet pod per machine,
+reference deploy/, SURVEY.md section 2.9) — it owns one machine's capture
+window. On real hardware the axis spans chips across hosts so the reduce
+rides ICI within a pod and DCN across pods; in tests it spans the virtual
+CPU devices enabled by --xla_force_host_platform_device_count.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+FLEET_AXIS = "node"
+
+
+def fleet_mesh(n_nodes: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh of `n_nodes` devices along the "node" axis."""
+    if devices is None:
+        devices = jax.devices()
+    if n_nodes is None:
+        n_nodes = len(devices)
+    if n_nodes > len(devices):
+        raise ValueError(
+            f"requested {n_nodes} fleet nodes but only {len(devices)} devices"
+        )
+    return Mesh(np.asarray(devices[:n_nodes]), (FLEET_AXIS,))

@@ -1,0 +1,12 @@
+"""Address -> symbol resolution (reference layer L2, SURVEY.md section 2.2).
+
+Agent-side symbolization covers only what cannot be done server-side:
+kernel functions (kallsyms) and JITed code (perf maps); everything else
+ships normalized addresses + build ids and is symbolized by the server.
+"""
+
+from parca_agent_tpu.symbolize.ksym import KsymCache
+from parca_agent_tpu.symbolize.perfmap import PerfMapCache
+from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+
+__all__ = ["KsymCache", "PerfMapCache", "Symbolizer"]

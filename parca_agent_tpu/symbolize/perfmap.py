@@ -1,0 +1,114 @@
+"""JIT symbolization via perf map files.
+
+JIT runtimes (node, JVMs with perf-map-agent, ...) drop
+`/tmp/perf-<pid>.map` files of `start size name` lines. The agent must read
+them through the *target's* mount namespace and with the target's
+*namespaced* pid: `/proc/<pid>/root/tmp/perf-<nspid>.map`, where nspid is
+the last field of the NSpid line in `/proc/<pid>/status` (reference
+pkg/perf/perf.go:128-142,165-209).
+
+Lookup contract matches the reference (perf.go:62-110): entries sorted by
+end address, binary search for the first entry with End > addr, hit iff its
+Start <= addr. Per-PID cache invalidated by content hash (perf.go:143-162).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from parca_agent_tpu.utils.filehash import hash_bytes
+from parca_agent_tpu.utils.vfs import VFS, RealFS
+
+
+class NoSymbolFound(LookupError):
+    pass
+
+
+@dataclasses.dataclass
+class PerfMap:
+    starts: np.ndarray  # uint64 [K], sorted by end
+    ends: np.ndarray    # uint64 [K]
+    names: list[str]
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def lookup(self, addr: int) -> str:
+        i = int(np.searchsorted(self.ends, np.uint64(addr), side="right"))
+        if i >= len(self.names) or int(self.starts[i]) > addr:
+            raise NoSymbolFound(hex(addr))
+        return self.names[i]
+
+    def lookup_many(self, addrs) -> list[str | None]:
+        addrs = np.asarray(addrs, np.uint64)
+        if not len(self.names):
+            return [None] * len(addrs)
+        idx = np.searchsorted(self.ends, addrs, side="right")
+        safe = np.minimum(idx, len(self.names) - 1)
+        ok = (idx < len(self.names)) & (self.starts[safe] <= addrs)
+        return [self.names[int(i)] if hit else None
+                for i, hit in zip(safe, ok)]
+
+
+def parse_perf_map(data: bytes) -> PerfMap:
+    """Parse `start size symbol-with-possible-spaces` lines (perf.go:62-95)."""
+    starts: list[int] = []
+    sizes: list[int] = []
+    names: list[str] = []
+    for line in data.splitlines():
+        parts = line.split(b" ", 2)
+        if len(parts) != 3:
+            continue
+        try:
+            start = int(parts[0], 16)
+            size = int(parts[1], 16)
+        except ValueError:
+            continue
+        starts.append(start)
+        sizes.append(size)
+        names.append(parts[2].decode(errors="replace").rstrip())
+    s = np.array(starts, np.uint64)
+    e = s + np.array(sizes, np.uint64)
+    order = np.argsort(e, kind="stable")
+    return PerfMap(s[order], e[order], [names[i] for i in order])
+
+
+def namespaced_pid(fs: VFS, pid: int) -> int:
+    """Innermost-namespace pid: last field of NSpid in /proc/pid/status."""
+    data = fs.read_bytes(f"/proc/{pid}/status")
+    for line in data.splitlines():
+        if line.startswith(b"NSpid:"):
+            fields = line.split()
+            if len(fields) >= 2:
+                return int(fields[-1])
+    return pid
+
+
+def perf_map_path(fs: VFS, pid: int) -> str:
+    nspid = namespaced_pid(fs, pid)
+    return f"/proc/{pid}/root/tmp/perf-{nspid}.map"
+
+
+class PerfMapCache:
+    """map_for_pid(pid) -> PerfMap, hash-invalidated per pid."""
+
+    def __init__(self, fs: VFS | None = None):
+        self._fs = fs or RealFS()
+        self._cache: dict[int, tuple[int, PerfMap]] = {}
+
+    def map_for_pid(self, pid: int) -> PerfMap:
+        """Raises FileNotFoundError when the process has no perf map."""
+        path = perf_map_path(self._fs, pid)
+        data = self._fs.read_bytes(path)
+        h = hash_bytes(data)
+        cached = self._cache.get(pid)
+        if cached and cached[0] == h:
+            return cached[1]
+        m = parse_perf_map(data)
+        self._cache[pid] = (h, m)
+        return m
+
+    def evict(self, pid: int) -> None:
+        self._cache.pop(pid, None)

@@ -1,0 +1,103 @@
+"""Symbolizer front-end: attach functions/lines to aggregated profiles.
+
+Mirrors the reference's agent-side symbolization scope (pkg/symbol/
+symbol.go:55-139): kernel locations through the kallsyms cache, JITed user
+locations through perf maps; everything else is left for server-side
+symbolization (normalized address + build id travel in the profile).
+
+Operates on the array-shaped PidProfile: kernel locations are resolved as
+one batched ksym lookup across ALL profiles of a window (one searchsorted
+over the sorted symbol table), not per-address calls.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+from parca_agent_tpu.aggregator.base import PidProfile
+from parca_agent_tpu.symbolize.ksym import KsymCache
+from parca_agent_tpu.symbolize.perfmap import PerfMapCache
+
+
+class Symbolizer:
+    def __init__(self, ksym: KsymCache | None = None,
+                 perf: PerfMapCache | None = None):
+        self._ksym = ksym
+        self._perf = perf
+        self.last_errors: dict[int, Exception] = {}
+        self._fn_ids: dict[int, dict[str, int]] = {}
+
+    def symbolize(self, profiles: Iterable[PidProfile]) -> None:
+        """Fill functions/loc_lines in place for each profile."""
+        profiles = list(profiles)
+        self._fn_ids = {}
+        self._resolve_kernel(profiles)
+        self._resolve_jit(profiles)
+        self._fn_ids = {}
+
+    def _resolve_kernel(self, profiles: list[PidProfile]) -> None:
+        if self._ksym is None:
+            return
+        # One batched resolve across the whole window.
+        all_addrs: list[int] = []
+        spans: list[tuple[PidProfile, np.ndarray]] = []
+        for p in profiles:
+            idx = np.flatnonzero(p.loc_is_kernel)
+            if len(idx):
+                spans.append((p, idx))
+                all_addrs.extend(int(a) for a in p.loc_address[idx])
+        if not all_addrs:
+            return
+        names = self._ksym.resolve(np.array(all_addrs, np.uint64))
+        pos = 0
+        for p, idx in spans:
+            self._ensure_lines(p)
+            for loc in idx:
+                name = names[pos]
+                pos += 1
+                if name:
+                    self._add_line(p, int(loc), name)
+
+    def _resolve_jit(self, profiles: list[PidProfile]) -> None:
+        if self._perf is None:
+            return
+        for p in profiles:
+            # JIT candidates: user locations that fell outside every known
+            # file-backed mapping (mapping_id 0), plus locations whose
+            # mapping is anonymous — matches the reference's "not found in
+            # object files" fallback ordering (symbol.go:96-139).
+            idx = np.flatnonzero(~p.loc_is_kernel & (p.loc_mapping_id == 0))
+            if not len(idx):
+                continue
+            try:
+                pmap = self._perf.map_for_pid(p.pid)
+            except FileNotFoundError:
+                continue
+            except Exception as e:  # pragma: no cover - defensive
+                self.last_errors[p.pid] = e
+                continue
+            names = pmap.lookup_many(p.loc_address[idx])
+            self._ensure_lines(p)
+            for loc, name in zip(idx, names):
+                if name:
+                    self._add_line(p, int(loc), name)
+
+    def _ensure_lines(self, p: PidProfile) -> None:
+        if p.loc_lines is None:
+            p.loc_lines = [[] for _ in range(p.n_locations)]
+
+    def _add_line(self, p: PidProfile, loc_index: int, name: str) -> None:
+        # Dedup function names within the profile (reference symbol.go:75-93
+        # keeps one Function per name); name->1-based id index kept per
+        # profile object to stay O(1) per line.
+        fn_ids = self._fn_ids.setdefault(id(p), {})
+        if not fn_ids and p.functions:
+            fn_ids.update((f[0], i + 1) for i, f in enumerate(p.functions))
+        fid = fn_ids.get(name)
+        if fid is None:
+            p.functions.append((name, name, "", 0))
+            fid = len(p.functions)
+            fn_ids[name] = fid
+        p.loc_lines[loc_index].append((fid, 0))

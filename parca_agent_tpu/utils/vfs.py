@@ -1,0 +1,102 @@
+"""Filesystem injection seam.
+
+Every component that reads procfs or ELF files takes a `VFS` so tests run
+against in-memory trees — the role `pkg/testutil/fs.go:30-55`'s
+NewFakeFS/NewErrorFS plays in the reference's test strategy (SURVEY.md
+section 4). Paths are absolute strings; FakeFS keys are absolute paths.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Iterable, Protocol
+
+
+class VFS(Protocol):
+    def read_bytes(self, path: str) -> bytes: ...
+    def exists(self, path: str) -> bool: ...
+    def listdir(self, path: str) -> list[str]: ...
+    def open(self, path: str) -> io.BufferedIOBase: ...
+    def stat_signature(self, path: str) -> tuple: ...
+
+
+class RealFS:
+    """The host filesystem."""
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> list[str]:
+        return os.listdir(path)
+
+    def open(self, path: str):
+        return open(path, "rb")
+
+    def stat_signature(self, path: str) -> tuple:
+        st = os.stat(path)
+        return (st.st_size, st.st_mtime_ns, st.st_ino)
+
+
+class FakeFS:
+    """In-memory tree: {absolute_path: bytes}."""
+
+    def __init__(self, files: dict[str, bytes] | None = None):
+        self.files = dict(files or {})
+        self._version = 0
+
+    def put(self, path: str, data: bytes) -> None:
+        self.files[path] = data
+        self._version += 1
+
+    def read_bytes(self, path: str) -> bytes:
+        try:
+            return self.files[path]
+        except KeyError:
+            raise FileNotFoundError(path) from None
+
+    def exists(self, path: str) -> bool:
+        if path in self.files:
+            return True
+        prefix = path.rstrip("/") + "/"
+        return any(p.startswith(prefix) for p in self.files)
+
+    def listdir(self, path: str) -> list[str]:
+        prefix = path.rstrip("/") + "/"
+        names = {p[len(prefix):].split("/", 1)[0]
+                 for p in self.files if p.startswith(prefix)}
+        if not names and not self.exists(path):
+            raise FileNotFoundError(path)
+        return sorted(names)
+
+    def open(self, path: str):
+        return io.BytesIO(self.read_bytes(path))
+
+    def stat_signature(self, path: str) -> tuple:
+        data = self.read_bytes(path)
+        return (len(data), self._version, 0)
+
+
+class ErrorFS:
+    """Every operation raises `err` — exercises error paths in tests."""
+
+    def __init__(self, err: Exception):
+        self.err = err
+
+    def _raise(self, *a, **k):
+        raise self.err
+
+    read_bytes = exists = listdir = open = stat_signature = _raise
+
+
+def fake_procfs(pids: Iterable[int], extra: dict[str, bytes] | None = None) -> FakeFS:
+    """A minimal /proc skeleton for the given pids."""
+    files = {}
+    for pid in pids:
+        files[f"/proc/{pid}/comm"] = f"proc{pid}\n".encode()
+    files.update(extra or {})
+    return FakeFS(files)

@@ -1,0 +1,93 @@
+"""Fleet-merge tests on the virtual 8-device CPU mesh (BASELINE config #5)."""
+
+import numpy as np
+
+from parca_agent_tpu.ops.sketch import cm_build, cm_query, hll_build, hll_estimate
+from parca_agent_tpu.parallel.fleet import (
+    PAD_HASH,
+    FleetMergeSpec,
+    fleet_merge_exact,
+    fleet_merge_sketches,
+)
+from parca_agent_tpu.parallel.mesh import fleet_mesh
+
+
+def _node_streams(n_nodes=8, rows=512, live_frac=0.8, seed=0):
+    rng = np.random.default_rng(seed)
+    hashes = np.full((n_nodes, rows), PAD_HASH, np.uint32)
+    counts = np.zeros((n_nodes, rows), np.int32)
+    for node in range(n_nodes):
+        k = int(rows * live_frac)
+        # Overlapping hash population across nodes: same stacks seen fleetwide.
+        hashes[node, :k] = rng.integers(0, 4096, k, dtype=np.uint64).astype(np.uint32)
+        counts[node, :k] = rng.integers(1, 100, k, dtype=np.int64).astype(np.int32)
+    return hashes, counts
+
+
+def test_mesh_has_8_devices():
+    assert fleet_mesh(8).devices.size == 8
+
+
+def test_sketch_merge_matches_single_node_build():
+    spec = FleetMergeSpec()
+    hashes, counts = _node_streams()
+    cm, regs, total = fleet_merge_sketches(hashes, counts, spec)
+
+    live = hashes != PAD_HASH
+    flat_h = hashes[live]
+    flat_c = counts[live]
+    assert total == int(flat_c.sum())
+    assert np.array_equal(cm, cm_build(flat_h, flat_c.astype(np.int32), spec.cm))
+    assert np.array_equal(regs, hll_build(flat_h, spec.hll))
+
+
+def test_sketch_estimates_reasonable():
+    spec = FleetMergeSpec()
+    hashes, counts = _node_streams(seed=3)
+    cm, regs, _ = fleet_merge_sketches(hashes, counts, spec)
+
+    live = hashes != PAD_HASH
+    uniq = np.unique(hashes[live])
+    true = np.zeros(len(uniq), np.int64)
+    for node in range(hashes.shape[0]):
+        idx = np.searchsorted(uniq, hashes[node][live[node]])
+        np.add.at(true, idx, counts[node][live[node]])
+    est = cm_query(cm, uniq, spec.cm).astype(np.int64)
+    assert np.all(est >= true)
+    card = hll_estimate(regs, spec.hll)
+    assert abs(card - len(uniq)) / len(uniq) < 5 * spec.hll.rel_error
+
+
+def test_exact_merge_dedups_across_nodes():
+    hashes, counts = _node_streams(seed=5)
+    uh, uc = fleet_merge_exact(hashes, counts)
+
+    live = hashes != PAD_HASH
+    uniq = np.unique(hashes[live])
+    true = np.zeros(len(uniq), np.int64)
+    for node in range(hashes.shape[0]):
+        idx = np.searchsorted(uniq, hashes[node][live[node]])
+        np.add.at(true, idx, counts[node][live[node]])
+
+    order = np.argsort(uh)
+    assert np.array_equal(uh[order], uniq)
+    assert np.array_equal(uc[order].astype(np.int64), true)
+
+
+def test_dead_node_is_identity():
+    """SURVEY.md section 5.3: merge tolerates missing nodes — an all-padding
+    shard must not change any reduction."""
+    spec = FleetMergeSpec()
+    hashes, counts = _node_streams(seed=11)
+    dead_h = hashes.copy()
+    dead_c = counts.copy()
+    dead_h[3] = PAD_HASH
+    dead_c[3] = 0
+
+    cm_a, regs_a, tot_a = fleet_merge_sketches(dead_h, dead_c, spec)
+    live = dead_h != PAD_HASH
+    flat_h = dead_h[live]
+    flat_c = dead_c[live]
+    assert tot_a == int(flat_c.sum())
+    assert np.array_equal(cm_a, cm_build(flat_h, flat_c.astype(np.int32), spec.cm))
+    assert np.array_equal(regs_a, hll_build(flat_h, spec.hll))

@@ -1,0 +1,85 @@
+"""Sketch op tests: bounds, merges, host/device agreement."""
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.ops.sketch import (
+    CountMinSpec,
+    HLLSpec,
+    cm_build,
+    cm_merge,
+    cm_query,
+    hll_build,
+    hll_estimate,
+    hll_merge,
+)
+
+
+def _stream(n_items, seed=0):
+    rng = np.random.default_rng(seed)
+    hashes = rng.integers(0, 1 << 32, n_items, dtype=np.uint64).astype(np.uint32)
+    counts = (rng.zipf(1.5, n_items) % 1000 + 1).astype(np.int32)
+    return hashes, counts
+
+
+def test_cm_never_underestimates():
+    spec = CountMinSpec(depth=4, width=1 << 12)
+    hashes, counts = _stream(5000)
+    # Duplicate hashes must accumulate; build true totals per unique hash.
+    uniq, inv = np.unique(hashes, return_inverse=True)
+    true = np.zeros(len(uniq), np.int64)
+    np.add.at(true, inv, counts)
+    table = cm_build(hashes, counts, spec)
+    est = cm_query(table, uniq, spec).astype(np.int64)
+    assert np.all(est >= true)
+    # Average overestimate stays within a few epsilon*total.
+    total = counts.sum()
+    assert (est - true).mean() <= 5 * spec.epsilon * total
+
+
+def test_cm_merge_equals_concat():
+    spec = CountMinSpec(depth=3, width=1 << 10)
+    h1, c1 = _stream(2000, seed=1)
+    h2, c2 = _stream(2000, seed=2)
+    merged = cm_merge(cm_build(h1, c1, spec), cm_build(h2, c2, spec))
+    direct = cm_build(np.concatenate([h1, h2]), np.concatenate([c1, c2]), spec)
+    assert np.array_equal(merged, direct)
+
+
+def test_cm_device_matches_host():
+    import jax.numpy as jnp
+
+    spec = CountMinSpec(depth=4, width=1 << 10)
+    hashes, counts = _stream(3000, seed=3)
+    host = cm_build(hashes, counts, spec)
+    dev = np.asarray(cm_build(jnp.asarray(hashes), jnp.asarray(counts), spec))
+    assert np.array_equal(host, dev)
+
+
+@pytest.mark.parametrize("true_card", [100, 10_000, 200_000])
+def test_hll_accuracy(true_card):
+    spec = HLLSpec(p=12)
+    rng = np.random.default_rng(true_card)
+    hashes = rng.permutation(1 << 24)[:true_card].astype(np.uint32)
+    est = hll_estimate(hll_build(hashes, spec), spec)
+    assert abs(est - true_card) / true_card < 5 * spec.rel_error
+
+
+def test_hll_merge_is_union():
+    spec = HLLSpec(p=10)
+    rng = np.random.default_rng(7)
+    a = rng.integers(0, 1 << 32, 5000, dtype=np.uint64).astype(np.uint32)
+    b = rng.integers(0, 1 << 32, 5000, dtype=np.uint64).astype(np.uint32)
+    merged = hll_merge(hll_build(a, spec), hll_build(b, spec))
+    direct = hll_build(np.concatenate([a, b]), spec)
+    assert np.array_equal(merged, direct)
+
+
+def test_hll_device_matches_host():
+    import jax.numpy as jnp
+
+    spec = HLLSpec(p=8)
+    hashes, _ = _stream(2000, seed=9)
+    host = hll_build(hashes, spec)
+    dev = np.asarray(hll_build(jnp.asarray(hashes), spec))
+    assert np.array_equal(host, dev)

@@ -1,0 +1,152 @@
+"""Symbolize layer tests: kallsyms, perf maps, front-end (fake fs)."""
+
+import numpy as np
+
+from parca_agent_tpu.aggregator.cpu import CPUAggregator
+from parca_agent_tpu.capture.formats import (
+    KERNEL_ADDR_START,
+    STACK_SLOTS,
+    MappingTable,
+    WindowSnapshot,
+)
+from parca_agent_tpu.symbolize.ksym import KsymCache, parse_kallsyms
+from parca_agent_tpu.symbolize.perfmap import (
+    NoSymbolFound,
+    PerfMapCache,
+    namespaced_pid,
+    parse_perf_map,
+)
+from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+from parca_agent_tpu.utils.vfs import FakeFS
+
+KALLSYMS = (
+    b"ffffffff81000000 T _text\n"
+    b"ffffffff81001000 T do_syscall_64\n"
+    b"ffffffff81002000 t __do_sys_read\n"
+    b"ffffffff81003000 D some_data\n"       # skipped (data)
+    b"ffffffff81004000 r some_rodata\n"     # skipped (rodata)
+    b"ffffffff81005000 T vfs_read [ext4]\n"
+)
+
+PERF_MAP = (
+    b"10000 400 jit_outer\n"
+    b"10400 200 jit_inner with spaces\n"
+    b"20000 100 jit_far\n"
+)
+
+
+def test_parse_kallsyms_skips_data_symbols():
+    addrs, names = parse_kallsyms(KALLSYMS)
+    assert names == ["_text", "do_syscall_64", "__do_sys_read", "vfs_read"]
+    assert addrs.dtype == np.uint64
+
+
+def test_ksym_resolution_and_cache():
+    fs = FakeFS({"/proc/kallsyms": KALLSYMS})
+    c = KsymCache(fs=fs)
+    out = c.resolve([0xFFFFFFFF81001010, 0xFFFFFFFF81001FFF, 0xFFFFFFFF81000000])
+    assert out == ["do_syscall_64", "do_syscall_64", "_text"]
+    # below first symbol -> None
+    assert c.resolve([0xFFFFFFFF80FFFFFF]) == [None]
+    # second resolve hits the LRU
+    before = c.hits
+    c.resolve([0xFFFFFFFF81001010])
+    assert c.hits == before + 1
+
+
+def test_ksym_hash_invalidation_only_on_change():
+    clock = [0.0]
+    fs = FakeFS({"/proc/kallsyms": KALLSYMS})
+    c = KsymCache(fs=fs, ttl_s=10.0, clock=lambda: clock[0])
+    assert c.resolve([0xFFFFFFFF81001010]) == ["do_syscall_64"]
+    # File changes but ttl hasn't elapsed: stale result is served.
+    fs.put("/proc/kallsyms", b"ffffffff81001000 T renamed_sym\n")
+    assert c.resolve([0xFFFFFFFF81001010]) == ["do_syscall_64"]
+    # After ttl the new content hash forces a reparse.
+    clock[0] = 11.0
+    assert c.resolve([0xFFFFFFFF81001010]) == ["renamed_sym"]
+
+
+def test_perf_map_lookup_semantics():
+    m = parse_perf_map(PERF_MAP)
+    assert m.lookup(0x10000) == "jit_outer"
+    assert m.lookup(0x103FF) == "jit_outer"
+    assert m.lookup(0x10400) == "jit_inner with spaces"
+    try:
+        m.lookup(0x10800)  # gap between entries
+        assert False, "expected NoSymbolFound"
+    except NoSymbolFound:
+        pass
+    assert m.lookup_many([0x10001, 0x10800, 0x20050]) == [
+        "jit_outer", None, "jit_far",
+    ]
+
+
+def test_perf_map_nspid_translation():
+    fs = FakeFS({
+        "/proc/42/status": b"Name:\tnode\nNSpid:\t42\t7\n",
+        "/proc/42/root/tmp/perf-7.map": PERF_MAP,
+    })
+    assert namespaced_pid(fs, 42) == 7
+    cache = PerfMapCache(fs=fs)
+    m = cache.map_for_pid(42)
+    assert m.lookup(0x10000) == "jit_outer"
+    # Cache reuses the parsed map while the content hash is unchanged.
+    assert cache.map_for_pid(42) is m
+    fs.put("/proc/42/root/tmp/perf-7.map", b"30000 10 fresh\n")
+    assert cache.map_for_pid(42).lookup(0x30005) == "fresh"
+
+
+def _snapshot_with_kernel_and_jit():
+    """One pid; stack = [jit addr (unmapped), mapped addr, kernel addr]."""
+    mt = MappingTable(
+        pids=np.array([9], np.int32),
+        starts=np.array([0x400000], np.uint64),
+        ends=np.array([0x500000], np.uint64),
+        offsets=np.array([0], np.uint64),
+        objs=np.array([0], np.int32),
+        obj_paths=("/bin/app",),
+        obj_buildids=("ab" * 20,),
+    )
+    stacks = np.zeros((1, STACK_SLOTS), np.uint64)
+    stacks[0, :3] = [0x10400, 0x400123, KERNEL_ADDR_START + 0x1000]
+    return WindowSnapshot(
+        pids=np.array([9], np.int32),
+        tids=np.array([9], np.int32),
+        counts=np.array([5], np.int64),
+        user_len=np.array([2], np.int32),
+        kernel_len=np.array([1], np.int32),
+        stacks=stacks,
+        mappings=mt,
+    )
+
+
+def test_symbolizer_end_to_end():
+    ks = KsymCache(fs=FakeFS({
+        "/proc/kallsyms": b"ffff800000000000 T kfunc\n"
+    }))
+    pm = PerfMapCache(fs=FakeFS({
+        "/proc/9/status": b"NSpid:\t9\n",
+        "/proc/9/root/tmp/perf-9.map": PERF_MAP,
+    }))
+    profiles = CPUAggregator().aggregate(_snapshot_with_kernel_and_jit())
+    Symbolizer(ksym=ks, perf=pm).symbolize(profiles)
+    (p,) = profiles
+    names = {f[0] for f in p.functions}
+    assert names == {"kfunc", "jit_inner with spaces"}
+    # Each symbolized location points at its function.
+    by_addr = {int(a): lines for a, lines in zip(p.loc_address, p.loc_lines)}
+    kloc = by_addr[KERNEL_ADDR_START + 0x1000]
+    jloc = by_addr[0x10400]
+    assert len(kloc) == 1 and len(jloc) == 1
+    assert p.functions[kloc[0][0] - 1][0] == "kfunc"
+    assert p.functions[jloc[0][0] - 1][0] == "jit_inner with spaces"
+    # The mapped, non-JIT user address got no agent-side symbols.
+    assert by_addr[0x400123] == []
+
+
+def test_symbolizer_without_sources_is_noop():
+    profiles = CPUAggregator().aggregate(_snapshot_with_kernel_and_jit())
+    Symbolizer().symbolize(profiles)
+    assert profiles[0].functions == []
+    assert profiles[0].loc_lines is None
