@@ -1,0 +1,17 @@
+"""DWARF call-frame information (reference internal/dwarf/frame, layer L3)."""
+
+from parca_agent_tpu.dwarf.frame import (
+    CIE,
+    FDE,
+    FrameError,
+    RegRule,
+    Row,
+    RuleType,
+    execute_fde,
+    parse_eh_frame,
+)
+
+__all__ = [
+    "CIE", "FDE", "FrameError", "RegRule", "Row", "RuleType",
+    "execute_fde", "parse_eh_frame",
+]

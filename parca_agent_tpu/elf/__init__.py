@@ -1,0 +1,18 @@
+"""Minimal ELF toolkit (reference layer L2/L6 foundations).
+
+Own parser/serializer rather than a dependency: the agent needs exactly the
+subset the reference carries in pkg/elfreader, pkg/elfwriter, pkg/buildid
+and internal/pprof/elfexec — headers, program/section tables, notes, symbol
+tables, and base-address computation — and needs them against in-memory
+bytes from an injectable VFS.
+"""
+
+from parca_agent_tpu.elf.reader import ElfError, ElfFile, Note, Section, Segment
+from parca_agent_tpu.elf.buildid import build_id
+from parca_agent_tpu.elf.base import compute_base
+from parca_agent_tpu.elf.executable import is_aslr_eligible
+
+__all__ = [
+    "ElfError", "ElfFile", "Note", "Section", "Segment",
+    "build_id", "compute_base", "is_aslr_eligible",
+]

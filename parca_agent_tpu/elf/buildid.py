@@ -1,0 +1,53 @@
+"""Build-id extraction (role of reference pkg/buildid/buildid.go:36-122).
+
+Precedence mirrors the reference:
+  1. Go build id   — .note.go.buildid note (name "Go", type 4), the id the
+                     Go toolchain stamps (reference fastGoBuildID +
+                     internal/go/buildid fallback);
+  2. GNU build id  — .note.gnu.build-id note (name "GNU", type 3), hex;
+  3. fallback      — hash of .text contents, so stripped/noteless binaries
+                     still get a stable identity.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from parca_agent_tpu.elf.reader import ElfFile
+
+NT_GNU_BUILD_ID = 3
+NT_GO_BUILD_ID = 4
+
+
+def go_build_id(ef: ElfFile) -> str | None:
+    sec = ef.section(".note.go.buildid")
+    if sec is not None:
+        from parca_agent_tpu.elf.reader import parse_notes
+
+        for note in parse_notes(ef.section_data(sec), ef.end):
+            if note.name == "Go" and note.type == NT_GO_BUILD_ID and note.desc:
+                return note.desc.rstrip(b"\x00").decode(errors="replace")
+    return None
+
+
+def gnu_build_id(ef: ElfFile) -> str | None:
+    for note in ef.notes():
+        if note.name == "GNU" and note.type == NT_GNU_BUILD_ID and note.desc:
+            return note.desc.hex()
+    return None
+
+
+def text_hash_id(ef: ElfFile) -> str | None:
+    sec = ef.section(".text")
+    if sec is None:
+        return None
+    data = ef.section_data(sec)
+    if not data:
+        return None
+    return hashlib.blake2b(data, digest_size=20).hexdigest()
+
+
+def build_id(data_or_elf) -> str | None:
+    """Best-available build id for an ELF image (bytes or ElfFile)."""
+    ef = data_or_elf if isinstance(data_or_elf, ElfFile) else ElfFile(data_or_elf)
+    return go_build_id(ef) or gnu_build_id(ef) or text_hash_id(ef)

@@ -27,12 +27,13 @@ Per-node inputs are fixed-width [R] shards stacked to [n_nodes, R]; rows are
 already produces, not raw 128-slot stacks, per SURVEY.md section 7 hard
 part #3 (ship compacted streams, not raw addresses).
 
-Device counts ride int32 lanes (no x64 on TPU): per-node window totals are
-guarded < 2^31 upstream (TPUAggregator.aggregate), per-node totals are
-returned unsummed and added in int64 on the host, and merged count-min
-cells are checked non-negative — `fleet_size * window_total` must stay
-below 2^31 for the count-min merge, and violations raise instead of
-wrapping silently.
+Device counts ride int32 lanes (no x64 on TPU), so every on-device sum —
+per-node totals, merged count-min cells, cross-node exact group sums — is
+bounded by the FLEET-WIDE sample total. _check_streams therefore enforces
+`sum(all counts) < 2^31` up front (in int64, on host) and raises instead
+of letting any reduction wrap silently. Fleets hot enough to exceed 2^31
+samples per window must merge hierarchically (shorter windows or a tree of
+sub-fleet merges).
 """
 
 from __future__ import annotations
@@ -92,6 +93,11 @@ def _check_streams(node_hashes, node_counts):
         raise ValueError("node streams must be [n_nodes, R] and congruent")
     if np.any(node_counts < 0):
         raise ValueError("negative row count")
+    # Bounds every on-device int32 sum (group sums, count-min cells, totals).
+    if int(node_counts.astype(np.int64).sum()) >= 2**31:
+        raise ValueError(
+            "fleet-wide sample total exceeds int32; merge hierarchically"
+        )
     return node_hashes, node_counts
 
 
@@ -109,15 +115,10 @@ def fleet_merge_sketches(node_hashes, node_counts, spec=FleetMergeSpec(), mesh=N
         mesh = fleet_mesh(node_hashes.shape[0])
     prog = _sketch_program(mesh, spec)
     cm, regs, totals = prog(jnp.asarray(node_hashes), jnp.asarray(node_counts))
-    cm = np.asarray(cm[0])
-    if np.any(cm < 0):
-        raise OverflowError(
-            "count-min cell wrapped int32: fleet total exceeds 2^31; "
-            "shard the fleet or shorten the window"
-        )
-    # Per-node totals summed on host in int64 (device lanes are int32).
+    # Per-node totals summed on host in int64 (device lanes are int32;
+    # _check_streams bounds the fleet total so no device sum can wrap).
     total = int(np.asarray(totals).astype(np.int64).sum())
-    return cm, np.asarray(regs[0]), total
+    return np.asarray(cm[0]), np.asarray(regs[0]), total
 
 
 @functools.lru_cache(maxsize=8)

@@ -1,0 +1,10 @@
+"""Process address-space introspection (reference pkg/process, pkg/objectfile,
+pkg/address)."""
+
+from parca_agent_tpu.process.maps import ProcMapping, parse_proc_maps, ProcessMapCache
+from parca_agent_tpu.process.objectfile import ObjectFile, ObjectFileCache
+
+__all__ = [
+    "ProcMapping", "parse_proc_maps", "ProcessMapCache",
+    "ObjectFile", "ObjectFileCache",
+]

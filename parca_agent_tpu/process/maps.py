@@ -1,0 +1,127 @@
+"""/proc/PID/maps parsing and the per-window mapping table build.
+
+Role of the reference's pkg/process/maps.go + mapping.go: parse the text
+maps file, keep only file-backed executable mappings for profiling, backfill
+build IDs by opening each mapped ELF through /proc/PID/root (the target's
+mount namespace), and cache per PID with content-hash invalidation
+(maps.go:73-128).
+
+The output feeds capture.formats.MappingTable — one (pid, start)-sorted
+array table per window — which both aggregation backends join against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from parca_agent_tpu.capture.formats import MappingTable
+from parca_agent_tpu.utils.filehash import hash_bytes
+from parca_agent_tpu.utils.vfs import VFS, RealFS
+
+# Pseudo-paths that are never ELF objects.
+_SPECIAL = ("[vdso]", "[vsyscall]", "[stack]", "[heap]", "[anon", "[uprobes]")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcMapping:
+    start: int
+    end: int
+    perms: str
+    offset: int
+    dev: str
+    inode: int
+    path: str
+
+    @property
+    def executable(self) -> bool:
+        return "x" in self.perms
+
+    @property
+    def file_backed(self) -> bool:
+        return bool(self.path) and not self.path.startswith(_SPECIAL) \
+            and self.inode != 0
+
+
+def parse_proc_maps(data: bytes) -> list[ProcMapping]:
+    """Parse maps lines: start-end perms offset dev inode [path]."""
+    out = []
+    for line in data.splitlines():
+        parts = line.split(None, 5)
+        if len(parts) < 5:
+            continue
+        try:
+            start_s, end_s = parts[0].split(b"-")
+            start, end = int(start_s, 16), int(end_s, 16)
+            offset = int(parts[2], 16)
+            inode = int(parts[4])
+        except ValueError:
+            continue
+        path = parts[5].decode(errors="replace").strip() if len(parts) == 6 else ""
+        out.append(ProcMapping(start, end, parts[1].decode(), offset,
+                               parts[3].decode(), inode, path))
+    return out
+
+
+class ProcessMapCache:
+    """mappings_for_pid(pid) -> [ProcMapping], hash-invalidated per pid."""
+
+    def __init__(self, fs: VFS | None = None):
+        self._fs = fs or RealFS()
+        self._cache: dict[int, tuple[int, list[ProcMapping]]] = {}
+
+    def mappings_for_pid(self, pid: int) -> list[ProcMapping]:
+        data = self._fs.read_bytes(f"/proc/{pid}/maps")
+        h = hash_bytes(data)
+        cached = self._cache.get(pid)
+        if cached and cached[0] == h:
+            return cached[1]
+        maps = parse_proc_maps(data)
+        self._cache[pid] = (h, maps)
+        return maps
+
+    def evict(self, pid: int) -> None:
+        self._cache.pop(pid, None)
+
+    def executable_mappings(self, pid: int) -> list[ProcMapping]:
+        return [m for m in self.mappings_for_pid(pid)
+                if m.executable and m.file_backed]
+
+
+def host_path(pid: int, path: str) -> str:
+    """A target path seen through the target's mount namespace."""
+    return f"/proc/{pid}/root{path}"
+
+
+def build_mapping_table(
+    per_pid: dict[int, list[ProcMapping]],
+    build_ids: dict[str, str] | None = None,
+) -> MappingTable:
+    """Fold executable file-backed mappings of many PIDs into one sorted
+    MappingTable; objects dedup by path (as on a real host where every
+    process maps the same libc — reference pkg/debuginfo/manager.go:116-127
+    relies on exactly this fan-in for upload dedup)."""
+    build_ids = build_ids or {}
+    obj_ids: dict[str, int] = {}
+    rows: list[tuple[int, int, int, int, int]] = []
+    for pid, maps in per_pid.items():
+        for m in maps:
+            if not (m.executable and m.file_backed):
+                continue
+            obj = obj_ids.setdefault(m.path, len(obj_ids))
+            rows.append((pid, m.start, m.end, m.offset, obj))
+    if not rows:
+        return MappingTable.empty()
+    rows.sort(key=lambda r: (r[0], r[1]))
+    arr = np.array(rows, np.uint64)
+    paths = list(obj_ids)
+    return MappingTable(
+        pids=arr[:, 0].astype(np.int32),
+        starts=arr[:, 1],
+        ends=arr[:, 2],
+        offsets=arr[:, 3],
+        objs=arr[:, 4].astype(np.int32),
+        obj_paths=tuple(paths),
+        obj_buildids=tuple(build_ids.get(p, "") for p in paths),
+    )

@@ -1,0 +1,100 @@
+"""Object-file cache with lazy base computation.
+
+Role of the reference's pkg/objectfile/object_file.go + cache.go: open a
+mapped ELF once, extract its build id, and compute the normalization base
+lazily from the executable load segment and the process mapping that covers
+the sampled addresses (object_file.go:156-238, via elfexec.GetBase). The
+cache is keyed (pid, start, end, offset) with TTL + LRU (cache.go:28-86).
+
+Kernel objects: a mapping whose file has the `_stext`/`_text` relocation
+symbols gets its base from the stext offset instead (object_file.go:78-143)
+— handled here by the caller passing `stext_offset`.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+from parca_agent_tpu.elf.base import BaseError, compute_base
+from parca_agent_tpu.elf.buildid import build_id
+from parca_agent_tpu.elf.reader import ElfError, ElfFile
+from parca_agent_tpu.process.maps import ProcMapping, host_path
+from parca_agent_tpu.utils.vfs import VFS, RealFS
+
+
+class ObjectFile:
+    """One opened ELF + the mapping it was sampled through."""
+
+    def __init__(self, path: str, elf: ElfFile, mapping: ProcMapping):
+        self.path = path
+        self.elf = elf
+        self.mapping = mapping
+        self.build_id = build_id(elf) or ""
+        self._base: int | None = None
+
+    def base(self, stext_offset: int | None = None) -> int:
+        """Relocation base, computed once per object file (lazy, like the
+        reference's sync.Once around computeBase)."""
+        if self._base is None:
+            m = self.mapping
+            self._base = compute_base(
+                self.elf, self.elf.exec_load_segment(),
+                m.start, m.end, m.offset, stext_offset=stext_offset,
+            )
+        return self._base
+
+    def normalize(self, runtime_addr: int) -> int:
+        """Runtime address -> position-independent object address (the role
+        of reference pkg/address/normalizer.go:48-74)."""
+        return (runtime_addr - self.base()) % 2**64
+
+
+class ObjectFileCache:
+    """open(pid, mapping) -> ObjectFile | None with TTL+LRU eviction."""
+
+    def __init__(self, fs: VFS | None = None, size: int = 512,
+                 ttl_s: float = 300.0, clock=time.monotonic):
+        self._fs = fs or RealFS()
+        self._size = size
+        self._ttl = ttl_s
+        self._clock = clock
+        self._cache: OrderedDict[tuple, tuple[float, ObjectFile | None]] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, pid: int, mapping: ProcMapping) -> ObjectFile | None:
+        """None when the mapped file is unreadable or not a supported ELF
+        (the profiler treats that as 'cannot normalize', not an error)."""
+        key = (pid, mapping.start, mapping.end, mapping.offset, mapping.path)
+        now = self._clock()
+        hit = self._cache.get(key)
+        if hit is not None and now - hit[0] < self._ttl:
+            self._cache.move_to_end(key)
+            self.hits += 1
+            return hit[1]
+        self.misses += 1
+        obj: ObjectFile | None = None
+        try:
+            data = self._fs.read_bytes(host_path(pid, mapping.path))
+            obj = ObjectFile(mapping.path, ElfFile(data), mapping)
+        except (OSError, ElfError, BaseError):
+            obj = None
+        self._cache[key] = (now, obj)
+        self._cache.move_to_end(key)
+        while len(self._cache) > self._size:
+            self._cache.popitem(last=False)
+        return obj
+
+    def build_ids(self, per_pid: dict[int, list[ProcMapping]]) -> dict[str, str]:
+        """path -> build id for every distinct executable file-backed path
+        (feeds process.maps.build_mapping_table)."""
+        out: dict[str, str] = {}
+        for pid, maps in per_pid.items():
+            for m in maps:
+                if not (m.executable and m.file_backed) or m.path in out:
+                    continue
+                obj = self.get(pid, m)
+                if obj is not None and obj.build_id:
+                    out[m.path] = obj.build_id
+        return out

@@ -33,6 +33,7 @@ class Symbolizer:
         """Fill functions/loc_lines in place for each profile."""
         profiles = list(profiles)
         self._fn_ids = {}
+        self.last_errors = {}
         self._resolve_kernel(profiles)
         self._resolve_jit(profiles)
         self._fn_ids = {}
@@ -66,9 +67,15 @@ class Symbolizer:
         for p in profiles:
             # JIT candidates: user locations that fell outside every known
             # file-backed mapping (mapping_id 0), plus locations whose
-            # mapping is anonymous — matches the reference's "not found in
-            # object files" fallback ordering (symbol.go:96-139).
-            idx = np.flatnonzero(~p.loc_is_kernel & (p.loc_mapping_id == 0))
+            # mapping is anonymous (path "" — JIT code lives in anon rx
+            # mappings) — matches the reference's "not found in object
+            # files" fallback ordering (symbol.go:96-139).
+            anon_ids = np.array(
+                [0] + [m.id for m in p.mappings if not m.path], np.int32
+            )
+            idx = np.flatnonzero(
+                ~p.loc_is_kernel & np.isin(p.loc_mapping_id, anon_ids)
+            )
             if not len(idx):
                 continue
             try:

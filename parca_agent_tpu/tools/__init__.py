@@ -1,0 +1,1 @@
+"""Developer CLI tools (reference cmd/eh-frame)."""

@@ -1,0 +1,25 @@
+"""DWARF unwind-table pipeline (reference pkg/stack/unwind, layer L3)."""
+
+from parca_agent_tpu.unwind.table import (
+    CFA_EXPR_PLT1,
+    CFA_EXPR_PLT2,
+    CFA_TYPE_EXPRESSION,
+    CFA_TYPE_RBP,
+    CFA_TYPE_RSP,
+    RBP_TYPE_OFFSET,
+    RBP_TYPE_REGISTER,
+    RBP_TYPE_UNDEFINED,
+    ROW_DTYPE,
+    UnwindTableBuilder,
+    build_compact_table,
+    identify_expression,
+    lookup_rows,
+    shard_table,
+)
+
+__all__ = [
+    "CFA_EXPR_PLT1", "CFA_EXPR_PLT2", "CFA_TYPE_EXPRESSION", "CFA_TYPE_RBP",
+    "CFA_TYPE_RSP", "RBP_TYPE_OFFSET", "RBP_TYPE_REGISTER",
+    "RBP_TYPE_UNDEFINED", "ROW_DTYPE", "UnwindTableBuilder",
+    "build_compact_table", "identify_expression", "lookup_rows", "shard_table",
+]

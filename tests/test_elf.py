@@ -1,0 +1,167 @@
+"""ELF toolkit tests against freshly compiled fixture binaries.
+
+Fixtures are built with the local gcc at session scope (the role the
+reference's `make -C testdata` golden binaries play, SURVEY.md section 4);
+pyelftools — test-only dependency — is the oracle for header/section/note
+parity.
+"""
+
+import subprocess
+
+import pytest
+
+from parca_agent_tpu.elf.base import compute_base, object_address
+from parca_agent_tpu.elf.buildid import build_id, gnu_build_id, text_hash_id
+from parca_agent_tpu.elf.executable import is_aslr_eligible
+from parca_agent_tpu.elf.reader import (
+    ET_DYN,
+    ET_EXEC,
+    PT_LOAD,
+    ElfFile,
+)
+
+C_SRC = r"""
+#include <stdio.h>
+int hot(int n) { int s = 0; for (int i = 0; i < n; i++) s += i * i; return s; }
+int main(void) { printf("%d\n", hot(1000)); return 0; }
+"""
+
+
+@pytest.fixture(scope="session")
+def fixtures(tmp_path_factory):
+    d = tmp_path_factory.mktemp("elf-fixtures")
+    src = d / "prog.c"
+    src.write_text(C_SRC)
+    out = {}
+    for name, flags in {
+        "pie": ["-pie", "-fPIE"],
+        "nopie": ["-no-pie"],
+        "shared": ["-shared", "-fPIC"],
+    }.items():
+        path = d / name
+        cmd = ["gcc", "-O1", "-g", "-Wl,--build-id=sha1", *flags,
+               str(src), "-o", str(path)]
+        subprocess.run(cmd, check=True, capture_output=True)
+        out[name] = path.read_bytes()
+    return out
+
+
+def test_header_and_sections_match_pyelftools(fixtures):
+    from io import BytesIO
+
+    from elftools.elf.elffile import ELFFile as PyELF
+
+    for name, data in fixtures.items():
+        ours = ElfFile(data)
+        ref = PyELF(BytesIO(data))
+        assert ours.e_type == ref.header.e_type_raw if hasattr(ref.header, "e_type_raw") else True
+        assert ours.phnum == ref.num_segments()
+        assert ours.shnum == ref.num_sections()
+        our_names = [s.name for s in ours.sections]
+        ref_names = [s.name for s in ref.iter_sections()]
+        assert our_names == ref_names
+        # Section contents agree for .text
+        our_text = ours.section(".text")
+        ref_text = ref.get_section_by_name(".text")
+        assert ours.section_data(our_text) == ref_text.data()
+
+
+def test_elf_types(fixtures):
+    assert ElfFile(fixtures["nopie"]).e_type == ET_EXEC
+    assert ElfFile(fixtures["pie"]).e_type == ET_DYN
+    assert ElfFile(fixtures["shared"]).e_type == ET_DYN
+
+
+def test_gnu_build_id_matches_pyelftools(fixtures):
+    from io import BytesIO
+
+    from elftools.elf.elffile import ELFFile as PyELF
+
+    for name, data in fixtures.items():
+        ours = gnu_build_id(ElfFile(data))
+        ref = None
+        for sec in PyELF(BytesIO(data)).iter_sections():
+            if sec.name == ".note.gnu.build-id":
+                for note in sec.iter_notes():
+                    if note["n_type"] == "NT_GNU_BUILD_ID":
+                        ref = note["n_desc"]
+        assert ours is not None and ours == ref, name
+
+
+def test_build_id_fallback_is_text_hash():
+    # A synthetic ELF with no notes: build_id falls back to .text hash.
+    import struct
+
+    # Minimal ELF64 with one section header table: null + .text + .shstrtab
+    shstrtab = b"\x00.text\x00.shstrtab\x00"
+    text = b"\x90" * 32
+    ehsize, shentsize = 64, 64
+    text_off = ehsize
+    shstr_off = text_off + len(text)
+    shoff = shstr_off + len(shstrtab)
+    hdr = b"\x7fELF" + bytes([2, 1, 1, 0]) + b"\x00" * 8
+    hdr += struct.pack("<HHIQQQIHHHHHH", 2, 0x3E, 1, 0, 0, shoff, 0,
+                       ehsize, 0, 0, shentsize, 3, 2)
+    def sh(name_off, typ, addr, off, size):
+        return struct.pack("<IIQQQQIIQQ", name_off, typ, 0, addr, off, size,
+                           0, 0, 1, 0)
+    shs = sh(0, 0, 0, 0, 0) + sh(1, 1, 0x1000, text_off, len(text)) + \
+        sh(7, 3, 0, shstr_off, len(shstrtab))
+    data = hdr + text + shstrtab + shs
+    ef = ElfFile(data)
+    assert gnu_build_id(ef) is None
+    bid = build_id(ef)
+    assert bid == text_hash_id(ef) and len(bid) == 40
+
+
+def test_aslr_eligibility(fixtures):
+    assert not is_aslr_eligible(fixtures["nopie"])
+    assert is_aslr_eligible(fixtures["pie"])
+    assert is_aslr_eligible(fixtures["shared"])
+
+
+def test_compute_base_et_dyn(fixtures):
+    ef = ElfFile(fixtures["pie"])
+    seg = ef.exec_load_segment()
+    assert seg is not None and seg.flags & 1
+    # Simulate the loader mapping the x segment at a random page-aligned
+    # bias: the mapping covers the segment's page-truncated file range.
+    bias = 0x5555_5555_0000
+    page = 4096
+    offset = (seg.offset // page) * page
+    start = bias + offset
+    base = compute_base(ef, seg, start, start + seg.filesz, offset)
+    # The loader keeps runtime = bias + link address (page 0 of the file at
+    # `bias`), so every link-time address must normalize back exactly.
+    v_link = seg.vaddr + 0x123
+    runtime = bias + v_link + seg.offset - seg.vaddr
+    assert base == bias + seg.offset - seg.vaddr
+    assert object_address(runtime, base) == v_link
+
+
+def test_compute_base_et_exec(fixtures):
+    ef = ElfFile(fixtures["nopie"])
+    seg = ef.exec_load_segment()
+    # Non-PIE maps at its link address: base 0.
+    assert compute_base(ef, seg, seg.vaddr, seg.vaddr + seg.filesz, 0) == 0
+
+
+def test_compute_base_kernel_stext():
+    # KASLR'd kernel: ET_EXEC but relocated; stext runtime vs link offset.
+    link_stext = 0xFFFFFFFF81000000
+    runtime_stext = 0xFFFFFFFFA0000000
+    base = compute_base(ET_EXEC, None, runtime_stext, 2**64 - 1, 0,
+                        stext_offset=link_stext)
+    assert object_address(runtime_stext + 0x500, base) == link_stext + 0x500
+
+
+def test_symbols_contain_hot(fixtures):
+    ef = ElfFile(fixtures["nopie"])
+    names = {s.name for s in ef.symbols()}
+    assert "hot" in names and "main" in names
+
+
+def test_notes_iteration(fixtures):
+    ef = ElfFile(fixtures["pie"])
+    names = {(n.name, n.type) for n in ef.notes()}
+    assert ("GNU", 3) in names  # build id present among the notes

@@ -1,0 +1,121 @@
+"""Process maps + objectfile cache tests (fake procfs, real fixture ELF)."""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.process.maps import (
+    ProcessMapCache,
+    build_mapping_table,
+    parse_proc_maps,
+)
+from parca_agent_tpu.process.objectfile import ObjectFileCache
+from parca_agent_tpu.utils.vfs import FakeFS
+
+MAPS = (
+    b"00400000-00452000 r-xp 00000000 08:02 1234 /usr/bin/app\n"
+    b"00651000-00652000 rw-p 00051000 08:02 1234 /usr/bin/app\n"
+    b"7f3c00000000-7f3c00200000 r-xp 00000000 08:02 999 /usr/lib/libc.so.6\n"
+    b"7ffc12345000-7ffc12366000 rw-p 00000000 00:00 0 [stack]\n"
+    b"7f3c00300000-7f3c00301000 r-xp 00000000 00:00 0 \n"
+    b"ffffffffff600000-ffffffffff601000 --xp 00000000 00:00 0 [vsyscall]\n"
+)
+
+
+def test_parse_proc_maps():
+    maps = parse_proc_maps(MAPS)
+    assert len(maps) == 6
+    app = maps[0]
+    assert (app.start, app.end, app.offset) == (0x400000, 0x452000, 0)
+    assert app.perms == "r-xp" and app.executable and app.file_backed
+    assert maps[3].path == "[stack]" and not maps[3].file_backed
+    assert maps[4].path == "" and not maps[4].file_backed  # anon exec
+    assert maps[5].path == "[vsyscall]" and not maps[5].file_backed
+
+
+def test_map_cache_invalidation():
+    fs = FakeFS({"/proc/7/maps": MAPS})
+    c = ProcessMapCache(fs=fs)
+    a = c.mappings_for_pid(7)
+    assert c.mappings_for_pid(7) is a
+    fs.put("/proc/7/maps", MAPS + b"90000000-90001000 r-xp 00000000 08:02 2 /x\n")
+    b = c.mappings_for_pid(7)
+    assert b is not a and len(b) == len(a) + 1
+    assert [m.path for m in c.executable_mappings(7)] == [
+        "/usr/bin/app", "/usr/lib/libc.so.6", "/x",
+    ]
+
+
+def test_build_mapping_table_dedups_objects():
+    maps7 = parse_proc_maps(MAPS)
+    maps9 = parse_proc_maps(MAPS)  # same libc mapped in a second pid
+    table = build_mapping_table(
+        {7: maps7, 9: maps9}, build_ids={"/usr/lib/libc.so.6": "cafe"}
+    )
+    # 2 exec file-backed mappings per pid.
+    assert len(table) == 4
+    assert list(table.pids) == [7, 7, 9, 9]
+    assert np.all(np.diff(table.starts[:2].astype(np.int64)) > 0)
+    # objects dedup across pids: one entry for app, one for libc
+    assert len(table.obj_paths) == 2
+    libc_obj = table.obj_paths.index("/usr/lib/libc.so.6")
+    assert table.obj_buildids[libc_obj] == "cafe"
+
+
+@pytest.fixture(scope="session")
+def pie_binary(tmp_path_factory):
+    d = tmp_path_factory.mktemp("objfile")
+    src = d / "p.c"
+    src.write_text("int main(void){return 0;}\n")
+    out = d / "p"
+    subprocess.run(
+        ["gcc", "-pie", "-fPIE", "-Wl,--build-id=sha1", str(src), "-o", str(out)],
+        check=True, capture_output=True,
+    )
+    return out.read_bytes()
+
+
+def test_objectfile_cache_and_normalize(pie_binary):
+    from parca_agent_tpu.elf.reader import ElfFile
+
+    seg = ElfFile(pie_binary).exec_load_segment()
+    bias = 0x7F0000000000
+    offset = (seg.offset // 4096) * 4096
+    line = (
+        f"{bias + offset:x}-{bias + offset + seg.filesz:x} r-xp "
+        f"{offset:08x} 08:02 42 /app/p\n"
+    ).encode()
+    fs = FakeFS({
+        "/proc/5/maps": line,
+        "/proc/5/root/app/p": pie_binary,
+    })
+    maps = ProcessMapCache(fs=fs).executable_mappings(5)
+    assert len(maps) == 1
+    cache = ObjectFileCache(fs=fs)
+    obj = cache.get(5, maps[0])
+    assert obj is not None and obj.build_id
+    # ET_DYN: runtime = base + link address
+    link_addr = seg.vaddr + 0x10
+    runtime = obj.base() + link_addr
+    assert obj.normalize(runtime) == link_addr
+    # cache hit second time
+    assert cache.get(5, maps[0]) is obj and cache.hits == 1
+    # unreadable path -> None, cached
+    bad = maps[0].__class__(0x1000, 0x2000, "r-xp", 0, "08:02", 77, "/gone")
+    assert cache.get(5, bad) is None
+    assert cache.build_ids({5: maps}) == {"/app/p": obj.build_id}
+
+
+def test_objectfile_ttl_expiry(pie_binary):
+    from parca_agent_tpu.process.maps import parse_proc_maps as parse
+
+    clock = [0.0]
+    line = b"1000-2000 r-xp 00000000 08:02 42 /app/p\n"
+    fs = FakeFS({"/proc/5/maps": line, "/proc/5/root/app/p": pie_binary})
+    m = parse(line)[0]
+    cache = ObjectFileCache(fs=fs, ttl_s=10.0, clock=lambda: clock[0])
+    a = cache.get(5, m)
+    clock[0] = 11.0
+    b = cache.get(5, m)
+    assert a is not None and b is not None and b is not a
