@@ -1,0 +1,3 @@
+from parca_agent_tpu.cli import run
+
+raise SystemExit(run())

@@ -1,0 +1,17 @@
+"""Agent transport: batching remote write + live-query tee
+(reference pkg/agent)."""
+
+from parca_agent_tpu.agent.profilestore import (
+    RawSeries,
+    encode_write_raw_request,
+    decode_write_raw_request,
+)
+from parca_agent_tpu.agent.batch import BatchWriteClient
+from parca_agent_tpu.agent.listener import MatchingProfileListener
+from parca_agent_tpu.agent.writer import FileProfileWriter, RemoteProfileWriter
+
+__all__ = [
+    "RawSeries", "encode_write_raw_request", "decode_write_raw_request",
+    "BatchWriteClient", "MatchingProfileListener",
+    "FileProfileWriter", "RemoteProfileWriter",
+]

@@ -1,0 +1,105 @@
+"""Batching remote-write client.
+
+Role of the reference's pkg/agent/batch_remote_write_client.go: buffer
+RawProfileSeries in memory, merging samples into an existing series when
+the label sets are equal (:144-184); a loop flushes every interval with
+exponential backoff capped at the interval (:88-142). Failures keep the
+batch for the next attempt; the capture path never blocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Protocol
+
+from parca_agent_tpu.agent.profilestore import RawSeries
+
+
+class StoreClient(Protocol):
+    def write_raw(self, series: list[RawSeries], normalized: bool) -> None: ...
+
+
+class NoopStoreClient:
+    """Default when no remote store is configured (reference agent.go:23-31)."""
+
+    def write_raw(self, series: list[RawSeries], normalized: bool) -> None:
+        pass
+
+
+class BatchWriteClient:
+    def __init__(self, client: StoreClient, interval_s: float = 10.0,
+                 initial_backoff_s: float = 0.5, clock=time.monotonic,
+                 sleep=None):
+        self._client = client
+        self._interval = interval_s
+        self._initial_backoff = initial_backoff_s
+        self._clock = clock
+        self._stop = threading.Event()
+        self._sleep = sleep or (lambda s: self._stop.wait(s))
+        self._lock = threading.Lock()
+        self._buffer: dict[tuple, RawSeries] = {}
+        self.sent_batches = 0
+        self.send_errors = 0
+
+    def write_raw(self, labels: dict[str, str], sample: bytes) -> None:
+        """Append one gzipped pprof for a label set (merge by label-set
+        equality, batch_remote_write_client.go:167-184)."""
+        s = RawSeries(dict(labels), [sample])
+        with self._lock:
+            existing = self._buffer.get(s.key())
+            if existing is not None:
+                existing.samples.append(sample)
+            else:
+                self._buffer[s.key()] = s
+
+    def _swap(self) -> list[RawSeries]:
+        with self._lock:
+            batch = list(self._buffer.values())
+            self._buffer = {}
+        return batch
+
+    def _restore(self, batch: list[RawSeries]) -> None:
+        """Failed batch goes back first so order survives a retry window."""
+        with self._lock:
+            merged: dict[tuple, RawSeries] = {s.key(): s for s in batch}
+            for s in self._buffer.values():
+                ex = merged.get(s.key())
+                if ex is not None:
+                    ex.samples.extend(s.samples)
+                else:
+                    merged[s.key()] = s
+            self._buffer = merged
+
+    def flush(self) -> bool:
+        """One batch attempt with capped exponential backoff; True on
+        success or empty batch."""
+        batch = self._swap()
+        if not batch:
+            return True
+        backoff = self._initial_backoff
+        deadline = self._clock() + self._interval
+        while True:
+            try:
+                self._client.write_raw(batch, normalized=True)
+                self.sent_batches += 1
+                return True
+            except Exception:
+                self.send_errors += 1
+                if self._clock() + backoff >= deadline or self._stop.is_set():
+                    self._restore(batch)
+                    return False
+                self._sleep(backoff)
+                backoff = min(backoff * 2, self._interval)
+
+    def run(self) -> None:
+        """Flush loop (one actor of the run group, reference main.go:250)."""
+        while not self._stop.is_set():
+            self._stop.wait(self._interval)
+            if self._stop.is_set():
+                break
+            self.flush()
+        self.flush()  # final drain
+
+    def stop(self) -> None:
+        self._stop.set()

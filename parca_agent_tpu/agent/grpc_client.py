@@ -1,0 +1,56 @@
+"""gRPC ProfileStore client.
+
+Role of the reference's grpcConn + profilestore client wiring
+(cmd/parca-agent/main.go:595-656): TLS or insecure channel, optional
+bearer token attached per-RPC, and the WriteRaw unary call. No generated
+stubs: the request is serialized by agent/profilestore.py and sent over a
+generic unary_unary handle, so the dependency stays import-gated.
+"""
+
+from __future__ import annotations
+
+from parca_agent_tpu.agent.profilestore import RawSeries, encode_write_raw_request
+
+WRITE_RAW_METHOD = "/parca.profilestore.v1alpha1.ProfileStoreService/WriteRaw"
+DEBUGINFO_UPLOAD_METHOD = "/parca.debuginfo.v1alpha1.DebuginfoService/Upload"
+
+
+class GRPCStoreClient:
+    def __init__(self, address: str, insecure: bool = False,
+                 bearer_token: str = "", timeout_s: float = 30.0):
+        try:
+            import grpc
+        except ImportError as e:  # pragma: no cover - grpc is in the image
+            raise RuntimeError("grpc package unavailable") from e
+        self._grpc = grpc
+        self._timeout = timeout_s
+        if insecure:
+            self._channel = grpc.insecure_channel(address)
+        else:
+            creds = grpc.ssl_channel_credentials()
+            if bearer_token:
+                call_creds = grpc.access_token_call_credentials(bearer_token)
+                creds = grpc.composite_channel_credentials(creds, call_creds)
+            self._channel = grpc.secure_channel(address, creds)
+        self._bearer = bearer_token if insecure else ""
+        self._write_raw = self._channel.unary_unary(
+            WRITE_RAW_METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+
+    def write_raw(self, series: list[RawSeries], normalized: bool) -> None:
+        metadata = []
+        if self._bearer:
+            # Insecure channels can't carry call credentials; send the
+            # token as plain metadata like the reference's perRequestBearerToken
+            # with insecure=true (main.go:620-637).
+            metadata.append(("authorization", f"Bearer {self._bearer}"))
+        self._write_raw(
+            encode_write_raw_request(series, normalized),
+            timeout=self._timeout,
+            metadata=metadata or None,
+        )
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,46 @@
+"""Profile writers: local files and remote (via listener/batcher).
+
+Role of the reference's pkg/profiler/profile_writer.go:32-97:
+FileProfileWriter stores each window's profile as a .pb.gz under a
+directory (--local-store-directory mode); RemoteProfileWriter gzips the
+encoded pprof and hands it to the write path (listener -> batch client).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import time
+
+
+def _series_filename(labels: dict[str, str], now_ns: int) -> str:
+    parts = [f"{k}={labels[k]}" for k in sorted(labels)
+             if not k.startswith("__")]
+    safe = "_".join(parts).replace("/", "-") or "profile"
+    return f"{safe}.{now_ns}.pb.gz"
+
+
+class FileProfileWriter:
+    def __init__(self, directory: str):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def write_raw(self, labels: dict[str, str], sample: bytes) -> None:
+        """`sample` is already a gzipped pprof proto."""
+        path = os.path.join(self._dir, _series_filename(labels, time.time_ns()))
+        with open(path, "wb") as f:
+            f.write(sample)
+
+    def write(self, labels: dict[str, str], pprof_bytes: bytes) -> None:
+        """Profile-writer interface: encode side handles gzip."""
+        self.write_raw(labels, gzip.compress(pprof_bytes, 1))
+
+
+class RemoteProfileWriter:
+    """pprof bytes -> gzip -> downstream write_raw sink."""
+
+    def __init__(self, sink):
+        self._sink = sink
+
+    def write(self, labels: dict[str, str], pprof_bytes: bytes) -> None:
+        self._sink.write_raw(labels, gzip.compress(pprof_bytes, 1))

@@ -1,0 +1,180 @@
+"""Unprivileged procfs capture source.
+
+The capture hierarchy (mirrors the reference's L0, redesigned for this
+stack — SURVEY.md section 2.11 keeps eBPF conceptually, but this framework
+must also run where neither eBPF nor perf_event_open is permitted):
+
+  1. native perf_event sampler (capture/live.py + native/) — real user+kernel
+     call stacks, needs perf_event_open permission;
+  2. THIS: /proc/<pid>/stat CPU-tick accounting — whole-machine per-process
+     CPU attribution with depth-1 stacks, needs only procfs read access.
+
+Per window: sample utime+stime of every PID at poll_hz; the per-PID tick
+delta over the window becomes the sample count (1 tick = 1/USER_HZ s of
+CPU). The single stack frame is the process's runtime entry point
+(ELF entry + load bias) so the profile symbolizes to the binary — honest
+"which process burns CPU" attribution, never fabricated call chains. The
+mapping table is the PID's real /proc/maps, so address normalization,
+build ids, and debuginfo upload all exercise the true pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from parca_agent_tpu.capture.formats import (
+    STACK_SLOTS,
+    MappingTable,
+    WindowSnapshot,
+)
+from parca_agent_tpu.process.maps import (
+    ProcessMapCache,
+    build_mapping_table,
+    host_path,
+)
+from parca_agent_tpu.utils.vfs import VFS, RealFS
+
+USER_HZ = 100  # kernel tick rate exposed in /proc/*/stat
+
+
+def read_cpu_ticks(fs: VFS, pid: int) -> int | None:
+    """utime+stime from /proc/pid/stat (fields 14/15, after the comm that
+    may itself contain spaces/parens)."""
+    try:
+        data = fs.read_bytes(f"/proc/{pid}/stat")
+    except OSError:
+        return None
+    # comm is parenthesized and may contain ')' — split after the LAST ')'.
+    rp = data.rfind(b")")
+    if rp < 0:
+        return None
+    fields = data[rp + 2:].split()
+    if len(fields) < 13:
+        return None
+    try:
+        return int(fields[11]) + int(fields[12])  # utime, stime
+    except ValueError:
+        return None
+
+
+class ProcfsSampler:
+    def __init__(self, fs: VFS | None = None, frequency_hz: int = 100,
+                 window_s: float = 10.0, poll_hz: float = 2.0,
+                 clock=time.monotonic, sleep=time.sleep):
+        self._fs = fs or RealFS()
+        self._freq = frequency_hz
+        self._window = window_s
+        self._poll_interval = 1.0 / poll_hz
+        self._clock = clock
+        self._sleep = sleep
+        self._maps = ProcessMapCache(fs=self._fs)
+        self._prev: dict[int, int] = {}
+        self._started = False
+
+    def _pids(self) -> list[int]:
+        try:
+            return [int(n) for n in self._fs.listdir("/proc") if n.isdigit()]
+        except OSError:
+            return []
+
+    def sample_ticks(self) -> dict[int, int]:
+        out = {}
+        for pid in self._pids():
+            t = read_cpu_ticks(self._fs, pid)
+            if t is not None:
+                out[pid] = t
+        return out
+
+    def _entry_address(self, pid: int) -> int | None:
+        """Runtime entry point: ELF entry + load bias of the exec mapping."""
+        from parca_agent_tpu.elf.base import BaseError, compute_base
+        from parca_agent_tpu.elf.reader import ElfError, ElfFile
+
+        try:
+            maps = self._maps.executable_mappings(pid)
+        except OSError:
+            return None
+        if not maps:
+            return None
+        m = maps[0]
+        try:
+            ef = ElfFile(self._fs.read_bytes(host_path(pid, m.path)))
+            base = compute_base(ef, ef.exec_load_segment(),
+                                m.start, m.end, m.offset)
+            return (ef.entry + base) % 2**64
+        except (OSError, ElfError, BaseError):
+            # Unreadable binary: attribute to the mapping start.
+            return m.start
+
+    def collect(self, deltas: dict[int, int]) -> WindowSnapshot:
+        """Tick deltas -> snapshot with real mappings + entry-point frames."""
+        rows = []
+        per_pid_maps = {}
+        for pid, ticks in sorted(deltas.items()):
+            if ticks <= 0:
+                continue
+            addr = self._entry_address(pid)
+            if addr is None:
+                continue
+            try:
+                per_pid_maps[pid] = self._maps.executable_mappings(pid)
+            except OSError:
+                per_pid_maps[pid] = []
+            # Scale kernel ticks (USER_HZ) to the nominal sampling frequency
+            # so counts are comparable with real samplers at frequency_hz.
+            count = max(1, ticks * self._freq // USER_HZ)
+            rows.append((pid, addr, count))
+
+        n = len(rows)
+        stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+        pids = np.zeros(n, np.int32)
+        counts = np.zeros(n, np.int64)
+        for i, (pid, addr, count) in enumerate(rows):
+            pids[i] = pid
+            stacks[i, 0] = addr
+            counts[i] = count
+        table = build_mapping_table(per_pid_maps) if per_pid_maps \
+            else MappingTable.empty()
+        return WindowSnapshot(
+            pids=pids,
+            tids=pids.copy(),
+            counts=counts,
+            user_len=np.full(n, 1, np.int32),
+            kernel_len=np.zeros(n, np.int32),
+            stacks=stacks,
+            mappings=table,
+            period_ns=int(1e9 / self._freq),
+            window_ns=int(self._window * 1e9),
+            time_ns=time.time_ns(),
+        )
+
+    def accumulate(self, window_deltas: dict[int, int]) -> None:
+        """One poll step: fold tick deltas since the previous step into
+        window_deltas. New PIDs first seen mid-window contribute their full
+        tick count (a process born inside the window spent all of it here);
+        PIDs that exit keep whatever they accumulated — the reason polling
+        runs at poll_hz instead of only at window edges."""
+        cur = self.sample_ticks()
+        for pid, t in cur.items():
+            prev = self._prev.get(pid)
+            delta = t if prev is None and self._started else t - (prev or t)
+            if delta > 0:
+                window_deltas[pid] = window_deltas.get(pid, 0) + delta
+        self._prev = cur
+
+    def poll(self) -> WindowSnapshot:
+        """Block for one window, accumulating tick deltas at poll_hz."""
+        if not self._started:
+            self._prev = self.sample_ticks()
+            self._started = True
+        window_deltas: dict[int, int] = {}
+        deadline = self._clock() + self._window
+        while True:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                break
+            self._sleep(min(self._poll_interval, remaining))
+            self.accumulate(window_deltas)
+        return self.collect(window_deltas)

@@ -1,0 +1,282 @@
+"""parca-agent-tpu CLI: flag parsing and component wiring.
+
+Role of the reference's cmd/parca-agent/main.go: kong flags (:79-117),
+environment checks (:174-191), component construction (:216-352), and the
+concurrent actor group (:505-592). Actors here are daemon threads — batch
+writer, discovery manager, profiler loop, HTTP server, config reloader —
+torn down on SIGINT/SIGTERM or when a replay source is exhausted.
+
+Run: python -m parca_agent_tpu --help
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from parca_agent_tpu import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="parca-agent-tpu",
+        description="TPU-native always-on sampling CPU profiler agent",
+    )
+    p.add_argument("--log-level", default="info",
+                   choices=["error", "warn", "info", "debug"])
+    p.add_argument("--http-address", default="127.0.0.1:7071",
+                   help="status/metrics/query listen address")
+    p.add_argument("--node", default="", help="node name label")
+    p.add_argument("--config-path", default="",
+                   help="YAML file with relabel_configs; hot-reloaded")
+    p.add_argument("--profiling-duration", type=float, default=10.0,
+                   help="aggregation window seconds")
+    p.add_argument("--profiling-cpu-sampling-frequency", type=int, default=100)
+    p.add_argument("--remote-store-address", default="")
+    p.add_argument("--remote-store-bearer-token", default="")
+    p.add_argument("--remote-store-bearer-token-file", default="")
+    p.add_argument("--remote-store-insecure", action="store_true")
+    p.add_argument("--remote-store-batch-write-interval", type=float,
+                   default=10.0)
+    p.add_argument("--local-store-directory", default="")
+    p.add_argument("--aggregator", default="cpu", choices=["cpu", "tpu"],
+                   help="window aggregation backend")
+    p.add_argument("--capture", default="procfs",
+                   choices=["procfs", "synthetic", "replay"],
+                   help="capture source (procfs sampler, synthetic load, "
+                        "or replay of saved snapshots)")
+    p.add_argument("--replay", nargs="*", default=[],
+                   help="snapshot files for --capture=replay")
+    p.add_argument("--metadata-external-labels", default="",
+                   help="k=v,k2=v2 labels attached to every profile")
+    p.add_argument("--debuginfo-upload-disable", action="store_true")
+    p.add_argument("--systemd-units", default="",
+                   help="comma-separated units to discover (empty = all)")
+    p.add_argument("--enable-systemd-discovery", action="store_true")
+    p.add_argument("--enable-cgroup-discovery", action="store_true")
+    p.add_argument("--windows", type=int, default=0,
+                   help="exit after N windows (0 = run forever)")
+    p.add_argument("--version", action="version",
+                   version=f"parca-agent-tpu {__version__}")
+    return p
+
+
+def _parse_external_labels(text: str) -> dict[str, str]:
+    out = {}
+    for part in filter(None, text.split(",")):
+        if "=" not in part:
+            raise ValueError(f"bad external label {part!r} (want k=v)")
+        k, v = part.split("=", 1)
+        out[k] = v
+    return out
+
+
+def run(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from parca_agent_tpu.agent.batch import BatchWriteClient, NoopStoreClient
+    from parca_agent_tpu.agent.listener import MatchingProfileListener
+    from parca_agent_tpu.agent.writer import FileProfileWriter, RemoteProfileWriter
+    from parca_agent_tpu.aggregator.cpu import CPUAggregator
+    from parca_agent_tpu.config import ConfigReloader, load_config_file
+    from parca_agent_tpu.debuginfo.manager import DebuginfoManager
+    from parca_agent_tpu.discovery.manager import DiscoveryManager
+    from parca_agent_tpu.kconfig import check_profiling_enabled, is_in_container
+    from parca_agent_tpu.labels.manager import LabelsManager
+    from parca_agent_tpu.metadata.providers import (
+        CgroupProvider,
+        ProcessProvider,
+        ServiceDiscoveryProvider,
+        SystemProvider,
+        TargetProvider,
+    )
+    from parca_agent_tpu.profiler.cpu import CPUProfiler
+    from parca_agent_tpu.symbolize import KsymCache, PerfMapCache, Symbolizer
+    from parca_agent_tpu.web import AgentHTTPServer
+
+    # -- env checks (reference main.go:174-191) -----------------------------
+    ok, missing, advisory = check_profiling_enabled()
+    if not ok:
+        print(f"kernel config missing required options: {missing}",
+              file=sys.stderr)
+    if advisory:
+        print(f"kernel config missing advisory (eBPF capture) options: "
+              f"{advisory}", file=sys.stderr)
+    if is_in_container():
+        print("running inside a container; host procfs must be mounted "
+              "for whole-machine profiling", file=sys.stderr)
+
+    # -- capture source ------------------------------------------------------
+    if args.capture == "replay":
+        from parca_agent_tpu.capture.replay import ReplaySource
+
+        source = ReplaySource(args.replay)
+    elif args.capture == "synthetic":
+        from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+        class SyntheticSource:
+            def __init__(self):
+                self._n = 0
+
+            def poll(self):
+                if args.windows and self._n >= args.windows:
+                    return None
+                self._n += 1
+                return generate(SyntheticSpec(seed=self._n))
+
+        source = SyntheticSource()
+    else:
+        from parca_agent_tpu.capture.procfs import ProcfsSampler
+
+        source = ProcfsSampler(
+            frequency_hz=args.profiling_cpu_sampling_frequency,
+            window_s=args.profiling_duration,
+        )
+
+    # -- aggregation backend -------------------------------------------------
+    fallback = None
+    if args.aggregator == "tpu":
+        from parca_agent_tpu.aggregator.tpu import TPUAggregator
+
+        aggregator = TPUAggregator()
+        fallback = CPUAggregator()
+    else:
+        aggregator = CPUAggregator()
+
+    # -- transport -----------------------------------------------------------
+    if args.remote_store_address:
+        from parca_agent_tpu.agent.grpc_client import GRPCStoreClient
+
+        token = args.remote_store_bearer_token
+        if args.remote_store_bearer_token_file:
+            with open(args.remote_store_bearer_token_file) as f:
+                token = f.read().strip()
+        store = GRPCStoreClient(args.remote_store_address,
+                                insecure=args.remote_store_insecure,
+                                bearer_token=token)
+    else:
+        store = NoopStoreClient()
+    batch = BatchWriteClient(store,
+                             interval_s=args.remote_store_batch_write_interval)
+    listener = MatchingProfileListener(next_writer=batch)
+    if args.local_store_directory:
+        file_writer = FileProfileWriter(args.local_store_directory)
+
+        class Tee:
+            def write(self, labels, pprof_bytes):
+                file_writer.write(labels, pprof_bytes)
+                RemoteProfileWriter(listener).write(labels, pprof_bytes)
+
+        writer = Tee()
+    else:
+        writer = RemoteProfileWriter(listener)
+
+    # -- discovery + labels --------------------------------------------------
+    discovery = DiscoveryManager()
+    providers = {}
+    if args.enable_systemd_discovery:
+        from parca_agent_tpu.discovery.systemd import SystemdDiscoverer
+
+        units = tuple(filter(None, args.systemd_units.split(",")))
+        providers["systemd"] = SystemdDiscoverer(units=units)
+    if args.enable_cgroup_discovery:
+        from parca_agent_tpu.discovery.cgroup import CgroupContainerDiscoverer
+
+        providers["cgroup"] = CgroupContainerDiscoverer()
+    discovery.apply_config(providers)
+
+    sd_provider = ServiceDiscoveryProvider()
+    labels_mgr = LabelsManager(
+        [
+            sd_provider,
+            ProcessProvider(),
+            CgroupProvider(),
+            SystemProvider(),
+            TargetProvider(node=args.node,
+                           external=_parse_external_labels(
+                               args.metadata_external_labels)),
+        ],
+        relabel_configs=(load_config_file(args.config_path).relabel_configs
+                         if args.config_path else []),
+        profiling_duration_s=args.profiling_duration,
+    )
+
+    # -- debuginfo -----------------------------------------------------------
+    debuginfo = None if args.debuginfo_upload_disable else DebuginfoManager()
+
+    # -- profiler ------------------------------------------------------------
+    windows_done = threading.Event()
+
+    def on_iteration(n):
+        sd_provider.update(discovery.groups())
+        if args.windows and n >= args.windows:
+            windows_done.set()
+
+    profiler = CPUProfiler(
+        source=source,
+        aggregator=aggregator,
+        fallback_aggregator=fallback,
+        symbolizer=Symbolizer(ksym=KsymCache(), perf=PerfMapCache()),
+        labels_manager=labels_mgr,
+        profile_writer=writer,
+        debuginfo=debuginfo,
+        duration_s=args.profiling_duration,
+        on_iteration=on_iteration,
+    )
+
+    # -- HTTP ----------------------------------------------------------------
+    host, _, port = args.http_address.rpartition(":")
+    http = AgentHTTPServer(host or "127.0.0.1", int(port),
+                           profilers=[profiler], batch_client=batch,
+                           listener=listener, version=__version__)
+
+    # -- config hot reload ---------------------------------------------------
+    reloader = None
+    if args.config_path:
+        reloader = ConfigReloader(
+            args.config_path,
+            [lambda cfg: labels_mgr.apply_config(cfg.relabel_configs)],
+        )
+
+    # -- run group (reference oklog/run, main.go:505-592) --------------------
+    threads = [threading.Thread(target=batch.run, name="batch", daemon=True)]
+    if reloader:
+        threads.append(threading.Thread(target=reloader.run, name="reload",
+                                        daemon=True))
+    profiler_thread = threading.Thread(target=profiler.run, name="profiler",
+                                       daemon=True)
+    threads.append(profiler_thread)
+
+    stop = threading.Event()
+
+    def shutdown(*_a):
+        stop.set()
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+
+    discovery.run()
+    http.start()
+    for t in threads:
+        t.start()
+    print(f"parca-agent-tpu listening on {args.http_address} "
+          f"(aggregator={args.aggregator}, capture={args.capture})")
+
+    try:
+        while not stop.is_set() and profiler_thread.is_alive() \
+                and not windows_done.is_set():
+            stop.wait(0.2)
+    finally:
+        profiler.stop()
+        if reloader:
+            reloader.stop()
+        batch.stop()
+        discovery.stop()
+        for t in threads:
+            t.join(timeout=5)
+        if debuginfo is not None:
+            debuginfo.close()
+        http.stop()
+    return 0

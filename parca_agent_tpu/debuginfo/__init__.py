@@ -1,0 +1,14 @@
+"""Debuginfo extract/find/upload (reference pkg/debuginfo, layer L4)."""
+
+from parca_agent_tpu.debuginfo.find import Finder
+from parca_agent_tpu.debuginfo.extract import extract_debuginfo, KEEP_SECTIONS
+from parca_agent_tpu.debuginfo.manager import (
+    DebuginfoClient,
+    DebuginfoManager,
+    NoopClient,
+)
+
+__all__ = [
+    "Finder", "extract_debuginfo", "KEEP_SECTIONS",
+    "DebuginfoClient", "DebuginfoManager", "NoopClient",
+]

@@ -1,0 +1,161 @@
+"""Debuginfo upload manager: dedup, extract, ship.
+
+Role of the reference's pkg/debuginfo/manager.go: called once per profiler
+iteration with the window's object files; work happens asynchronously so
+the capture loop never blocks on uploads (manager.go:130-155, errgroup
+limit 4 -> ThreadPoolExecutor(4) here). Per-build-id dedup via three
+caches: `uploading` (in-flight singleflight), `exists` (server-confirmed),
+`failed` (don't retry hopeless binaries every window) —
+manager.go:116-127,226-248.
+
+Flow per new build id (manager.go:157-270): prefer a separate debug file
+found on disk (Finder), else extract/strip the mapped binary; validate the
+result parses as ELF; ask the server Exists(build_id, hash) first; upload
+only on miss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Protocol
+
+from parca_agent_tpu.debuginfo.extract import extract_debuginfo
+from parca_agent_tpu.debuginfo.find import Finder
+from parca_agent_tpu.elf.reader import ElfError, ElfFile
+from parca_agent_tpu.process.maps import host_path
+from parca_agent_tpu.utils.vfs import VFS, RealFS
+
+
+class DebuginfoClient(Protocol):
+    """Server interface (reference client.go:22-38)."""
+
+    def exists(self, build_id: str, hash_: str) -> bool: ...
+    def upload(self, build_id: str, hash_: str, data: bytes) -> None: ...
+
+
+class NoopClient:
+    """Default when no remote store is configured (client.go:27-38)."""
+
+    def exists(self, build_id: str, hash_: str) -> bool:
+        return True  # pretend present: nothing to do
+
+    def upload(self, build_id: str, hash_: str, data: bytes) -> None:
+        pass
+
+
+@dataclasses.dataclass
+class UploadStats:
+    uploaded: int = 0
+    already_present: int = 0
+    extracted: int = 0
+    found_separate: int = 0
+    errors: int = 0
+
+
+class DebuginfoManager:
+    def __init__(self, client: DebuginfoClient | None = None,
+                 fs: VFS | None = None, finder: Finder | None = None,
+                 workers: int = 4, failed_ttl_s: float = 600.0,
+                 clock=None):
+        import time as _time
+
+        self._client = client or NoopClient()
+        self._fs = fs or RealFS()
+        self._finder = finder or Finder(fs=self._fs)
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="debuginfo")
+        self._lock = threading.Lock()
+        self._uploading: dict[str, object] = {}   # build_id -> Future
+        self._exists: set[str] = set()
+        # Failures expire so a transient store outage doesn't blacklist a
+        # binary for the agent's lifetime (the reference's caches are
+        # TTL-based for the same reason).
+        self._failed: dict[str, float] = {}       # build_id -> failed_at
+        self._failed_ttl = failed_ttl_s
+        self._clock = clock or _time.monotonic
+        self.stats = UploadStats()
+
+    def ensure_uploaded(self, objfiles: list[tuple[int, str, str]]) -> None:
+        """objfiles: (pid, path, build_id). Fire-and-forget per iteration
+        (manager.go:130-155); call drain() to wait (tests, shutdown)."""
+        for pid, path, build_id in objfiles:
+            if not build_id:
+                continue
+            with self._lock:
+                failed_at = self._failed.get(build_id)
+                if failed_at is not None:
+                    if self._clock() - failed_at < self._failed_ttl:
+                        continue
+                    del self._failed[build_id]
+                if build_id in self._exists or build_id in self._uploading:
+                    continue
+                fut = self._pool.submit(self._process, pid, path, build_id)
+                self._uploading[build_id] = fut
+                fut.add_done_callback(
+                    lambda _f, b=build_id: self._uploading.pop(b, None)
+                )
+
+    def drain(self) -> None:
+        while True:
+            with self._lock:
+                futs = list(self._uploading.values())
+            if not futs:
+                return
+            for f in futs:
+                f.result()
+
+    def close(self) -> None:
+        self.drain()
+        self._pool.shutdown(wait=True)
+
+    # -- internals ----------------------------------------------------------
+
+    def _process(self, pid: int, path: str, build_id: str) -> None:
+        try:
+            data = self._debug_payload(pid, path, build_id)
+            if data is None:
+                with self._lock:
+                    self._failed[build_id] = self._clock()
+                    self.stats.errors += 1
+                return
+            h = hashlib.sha256(data).hexdigest()
+            if self._client.exists(build_id, h):
+                with self._lock:
+                    self._exists.add(build_id)
+                    self.stats.already_present += 1
+                return
+            self._client.upload(build_id, h, data)
+            with self._lock:
+                self._exists.add(build_id)
+                self.stats.uploaded += 1
+        except Exception:
+            with self._lock:
+                self._failed[build_id] = self._clock()
+                self.stats.errors += 1
+
+    def _debug_payload(self, pid: int, path: str, build_id: str) -> bytes | None:
+        try:
+            raw = self._fs.read_bytes(host_path(pid, path))
+        except OSError:
+            return None
+        sep = self._finder.find(pid, path, data=raw, build_id=build_id)
+        if sep is not None:
+            try:
+                payload = self._fs.read_bytes(sep)
+                ElfFile(payload)  # validate
+                with self._lock:
+                    self.stats.found_separate += 1
+                return payload
+            except (OSError, ElfError):
+                pass
+        try:
+            payload = extract_debuginfo(raw)
+            ElfFile(payload)  # validate round-trips
+        except (ElfError, Exception):
+            return None
+        with self._lock:
+            self.stats.extracted += 1
+        return payload

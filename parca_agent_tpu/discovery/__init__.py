@@ -1,0 +1,10 @@
+"""Target discovery (reference pkg/discovery)."""
+
+from parca_agent_tpu.discovery.manager import DiscoveryManager, Group
+from parca_agent_tpu.discovery.systemd import SystemdDiscoverer
+from parca_agent_tpu.discovery.cgroup import CgroupContainerDiscoverer
+
+__all__ = [
+    "DiscoveryManager", "Group", "SystemdDiscoverer",
+    "CgroupContainerDiscoverer",
+]

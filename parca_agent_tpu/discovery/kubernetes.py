@@ -1,0 +1,86 @@
+"""Kubernetes pod discovery (node-filtered), gated on cluster access.
+
+Role of the reference's pkg/discovery/kubernetes.go + kubernetes/
+podinformer.go: watch pods scheduled to this node, resolve each running
+container's PIDs, and emit one Group per pod with
+node/namespace/pod/container/containerid labels (kubernetes.go:76-133).
+
+The kube API client is optional (no `kubernetes` package in this image and
+no cluster in CI): construction raises a clear error without it. PID
+resolution reuses the cgroup scan (discovery/cgroup.py) instead of talking
+CRI sockets — the container ids from the pod status join against the ids
+found in /proc/*/cgroup, which works across docker/containerd/cri-o
+without per-runtime socket clients (the role of
+kubernetes/containerruntimes/*).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable
+
+from parca_agent_tpu.discovery.cgroup import CgroupContainerDiscoverer
+from parca_agent_tpu.discovery.manager import Group
+
+
+@dataclasses.dataclass
+class PodDiscoverer:
+    node: str
+    poll_s: float = 5.0
+    cgroups: CgroupContainerDiscoverer = dataclasses.field(
+        default_factory=CgroupContainerDiscoverer
+    )
+
+    def __post_init__(self):
+        try:
+            from kubernetes import client, config  # type: ignore
+
+            try:
+                config.load_incluster_config()
+            except Exception:
+                config.load_kube_config()
+            self._core = client.CoreV1Api()
+        except ImportError as e:
+            raise RuntimeError(
+                "kubernetes discovery needs the 'kubernetes' client package; "
+                "use CgroupContainerDiscoverer for API-free container labels"
+            ) from e
+
+    def scrape(self) -> list[Group]:
+        pods = self._core.list_pod_for_all_namespaces(
+            field_selector=f"spec.nodeName={self.node}"
+        )
+        # container id -> pids from the local cgroup scan.
+        pid_groups = {g.labels.get("containerid"): g.pids
+                      for g in self.cgroups.scrape()}
+        groups = []
+        for pod in pods.items:
+            for cs in pod.status.container_statuses or []:
+                cid = (cs.container_id or "").rsplit("//", 1)[-1]
+                pids = pid_groups.get(cid, [])
+                if not pids:
+                    continue
+                groups.append(Group(
+                    source=f"pod/{pod.metadata.namespace}/{pod.metadata.name}"
+                           f"/{cs.name}",
+                    labels={
+                        "node": self.node,
+                        "namespace": pod.metadata.namespace,
+                        "pod": pod.metadata.name,
+                        "container": cs.name,
+                        "containerid": cid,
+                    },
+                    pids=list(pids),
+                    entry_pid=min(pids),
+                ))
+        return groups
+
+    def run(self, stop: threading.Event,
+            up: Callable[[list[Group]], None]) -> None:
+        while not stop.is_set():
+            try:
+                up(self.scrape())
+            except Exception:
+                pass  # API hiccup; retry next poll
+            stop.wait(self.poll_s)

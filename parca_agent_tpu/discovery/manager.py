@@ -1,0 +1,123 @@
+"""Discovery manager: providers push target groups, consumers read a
+debounced merged view.
+
+Role of the reference's Prometheus-SD-style pkg/discovery/
+discovery_manager.go:86-300: each named provider runs in its own thread
+pushing [Group] updates; the manager coalesces updates and publishes the
+full map at most once per debounce interval. Instead of Go channels the
+published state is a versioned snapshot guarded by a condition variable —
+`wait_for_update(version)` is the SyncCh equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Protocol
+
+
+@dataclasses.dataclass
+class Group:
+    """One target group (reference target.go:22-35)."""
+
+    source: str
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    pids: list[int] = dataclasses.field(default_factory=list)
+    entry_pid: int = 0
+
+
+class Discoverer(Protocol):
+    def run(self, stop: threading.Event,
+            up: Callable[[list[Group]], None]) -> None: ...
+
+
+class DiscoveryManager:
+    def __init__(self, debounce_s: float = 5.0):
+        self._debounce = debounce_s
+        self._providers: dict[str, Discoverer] = {}
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._groups: dict[str, dict[str, Group]] = {}  # provider -> source -> group
+        self._version = 0
+        self._last_publish = 0.0
+        self._dirty = False
+        self.failed_updates = 0
+
+    def apply_config(self, providers: dict[str, Discoverer]) -> None:
+        """Register providers (reference ApplyConfig + provider registry)."""
+        self._providers.update(providers)
+
+    def run(self) -> None:
+        for name, p in self._providers.items():
+            t = threading.Thread(
+                target=self._run_provider, args=(name, p),
+                name=f"discovery-{name}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _run_provider(self, name: str, p: Discoverer) -> None:
+        def up(groups: list[Group]) -> None:
+            self._update(name, groups)
+
+        try:
+            p.run(self._stop, up)
+        except Exception:
+            with self._lock:
+                self.failed_updates += 1
+
+    def _update(self, provider: str, groups: list[Group]) -> None:
+        with self._cond:
+            # Each provider update carries its FULL current target set:
+            # replacing the provider's map (not merging into it) is what
+            # lets dead sources disappear, so exited containers/units stop
+            # labeling recycled PIDs and the map stays bounded.
+            self._groups[provider] = {g.source: g for g in groups}
+            now = time.monotonic()
+            self._dirty = True
+            # Debounce: publish immediately if quiet, else mark dirty and
+            # let the next update (or reader poll) publish.
+            if now - self._last_publish >= self._debounce:
+                self._publish_locked(now)
+
+    def _publish_locked(self, now: float) -> None:
+        self._version += 1
+        self._last_publish = now
+        self._dirty = False
+        self._cond.notify_all()
+
+    def flush(self) -> None:
+        """Force-publish pending updates (tests, shutdown)."""
+        with self._cond:
+            if self._dirty:
+                self._publish_locked(time.monotonic())
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def groups(self) -> list[Group]:
+        """Current merged view across providers."""
+        with self._lock:
+            if self._dirty and \
+                    time.monotonic() - self._last_publish >= self._debounce:
+                self._publish_locked(time.monotonic())
+            return [g for per in self._groups.values() for g in per.values()]
+
+    def wait_for_update(self, seen_version: int, timeout: float | None = None) -> int:
+        """Block until the published version advances past seen_version
+        (the SyncCh read equivalent). Returns the new version."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._version > seen_version, timeout=timeout
+            )
+            return self._version

@@ -1,0 +1,135 @@
+"""ELF serializer: write a new ELF64 from chosen sections.
+
+Role of the reference's from-scratch pkg/elfwriter (elfwriter.go:64-790 +
+filtering_elfwriter.go): compose a valid ELF image containing a filtered
+subset of an input file's sections — the mechanism behind debuginfo
+extraction ("strip to only what symbolization needs", extract.go:46-123).
+
+Layout produced: ELF header | section bodies | .shstrtab | section header
+table. Program headers are not emitted: extracted debug files are consumed
+by symbolizers through the section table (same consumption path the
+reference's own extractor output serves); the original e_type/entry are
+preserved so base computation against the paired runtime binary still
+works from the original file.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from parca_agent_tpu.elf.reader import ElfFile, Section, SHT_NOBITS
+
+SHT_NULL = 0
+SHT_STRTAB = 3
+
+
+class ElfWriter:
+    """Collect (section, data) pairs, then serialize."""
+
+    def __init__(self, e_type: int, e_machine: int, entry: int = 0,
+                 endian: str = "<"):
+        self.e_type = e_type
+        self.e_machine = e_machine
+        self.entry = entry
+        self.end = endian
+        self._sections: list[tuple[Section, bytes]] = []
+
+    def add_section(self, sec: Section, data: bytes) -> None:
+        self._sections.append((sec, data))
+
+    def serialize(self) -> bytes:
+        ehsize, shentsize = 64, 64
+        # Section name string table; index 0 is the empty name.
+        names = bytearray(b"\x00")
+        name_off = {}
+        for sec, _ in self._sections:
+            name_off[sec.name] = len(names)
+            names += sec.name.encode() + b"\x00"
+        shstr_name_off = len(names)
+        names += b".shstrtab\x00"
+
+        # Body layout after the ELF header, honoring alignment.
+        bodies: list[tuple[int, bytes]] = []
+        pos = ehsize
+        laid: list[tuple[Section, int, int]] = []  # (sec, offset, size)
+        for sec, data in self._sections:
+            align = max(1, sec.addralign)
+            if sec.type != SHT_NOBITS:
+                pos = (pos + align - 1) // align * align
+                bodies.append((pos, bytes(data)))
+                laid.append((sec, pos, len(data)))
+                pos += len(data)
+            else:
+                laid.append((sec, pos, sec.size))
+        shstr_off = pos
+        bodies.append((pos, bytes(names)))
+        pos += len(names)
+        shoff = (pos + 7) // 8 * 8
+
+        n_secs = len(self._sections) + 2  # + null + shstrtab
+        shstrndx = n_secs - 1
+
+        out = bytearray(shoff + n_secs * shentsize)
+        ident = b"\x7fELF" + bytes([2, 1 if self.end == "<" else 2, 1]) + b"\x00" * 9
+        out[0:16] = ident
+        struct.pack_into(self.end + "HHIQQQIHHHHHH", out, 16,
+                         self.e_type, self.e_machine, 1, self.entry,
+                         0, shoff, 0, ehsize, 0, 0, shentsize, n_secs,
+                         shstrndx)
+        for off, data in bodies:
+            out[off: off + len(data)] = data
+
+        def put_sh(i, name, typ, flags, addr, off, size, link, info,
+                   align, entsize):
+            struct.pack_into(self.end + "IIQQQQIIQQ", out,
+                             shoff + i * shentsize, name, typ, flags, addr,
+                             off, size, link, info, align, entsize)
+
+        put_sh(0, 0, SHT_NULL, 0, 0, 0, 0, 0, 0, 0, 0)
+        # Callers (filter_elf) hand in sections whose link indices already
+        # point into THIS writer's table order; they are written verbatim.
+        for new_i, (sec, off, size) in enumerate(laid, start=1):
+            put_sh(new_i, name_off[sec.name], sec.type, sec.flags, sec.addr,
+                   off, size, sec.link, sec.info,
+                   max(1, sec.addralign), sec.entsize)
+        put_sh(shstrndx, shstr_name_off, SHT_STRTAB, 0, 0, shstr_off,
+               len(names), 0, 0, 1, 0)
+        return bytes(out)
+
+
+def filter_elf(data: bytes, keep) -> bytes:
+    """Copy an ELF keeping predicate-matched sections (the FilteringWriter
+    role, filtering_elfwriter.go:26-196). Sections a kept section `link`s to
+    (e.g. .symtab -> .strtab) are pulled in automatically and link indices
+    remapped."""
+    ef = ElfFile(data)
+    secs = ef.sections
+    chosen: list[int] = []
+    for i, sec in enumerate(secs):
+        if i == 0 or sec.type == SHT_NULL:
+            continue
+        if sec.name == ".shstrtab":
+            continue  # writer regenerates it
+        if keep(sec):
+            chosen.append(i)
+    # Pull linked sections (string/symbol tables).
+    pulled = True
+    while pulled:
+        pulled = False
+        for i in list(chosen):
+            link = secs[i].link
+            if link and link != 0 and link not in chosen \
+                    and secs[link].name != ".shstrtab":
+                chosen.append(link)
+                pulled = True
+    chosen.sort()
+
+    w = ElfWriter(ef.e_type, ef.e_machine, ef.entry, ef.end)
+    new_index = {old: new for new, old in enumerate(chosen, start=1)}
+    for i in chosen:
+        sec = secs[i]
+        import dataclasses as _dc
+
+        new_link = new_index.get(sec.link, 0)
+        w.add_section(_dc.replace(sec, link=new_link), ef.section_data(sec))
+    return w.serialize()

@@ -1,0 +1,7 @@
+"""Label production: metadata merge + Prometheus relabeling
+(reference pkg/metadata/labels)."""
+
+from parca_agent_tpu.labels.relabel import RelabelConfig, process as relabel_process
+from parca_agent_tpu.labels.manager import LabelsManager
+
+__all__ = ["RelabelConfig", "relabel_process", "LabelsManager"]

@@ -1,0 +1,16 @@
+"""Per-PID metadata providers (reference pkg/metadata)."""
+
+from parca_agent_tpu.metadata.providers import (
+    CgroupProvider,
+    CompilerProvider,
+    ProcessProvider,
+    Provider,
+    ServiceDiscoveryProvider,
+    SystemProvider,
+    TargetProvider,
+)
+
+__all__ = [
+    "Provider", "ProcessProvider", "CgroupProvider", "SystemProvider",
+    "CompilerProvider", "TargetProvider", "ServiceDiscoveryProvider",
+]

@@ -1,0 +1,175 @@
+"""Metadata providers: each contributes labels for a PID.
+
+Role of the reference's pkg/metadata/{metadata,process,cgroup,system,
+compiler,target,service_discovery}.go. The Provider protocol mirrors
+metadata.go:24-28 — {name, labels(pid), should_cache}; stateless providers
+are cached by the labels manager, stateful ones (service discovery) serve
+from their own state (metadata.go:30-78).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Protocol
+
+from parca_agent_tpu.utils.vfs import VFS, RealFS
+
+
+class Provider(Protocol):
+    name: str
+    should_cache: bool
+
+    def labels(self, pid: int) -> dict[str, str]: ...
+
+
+@dataclasses.dataclass
+class ProcessProvider:
+    """comm + executable path (reference process.go)."""
+
+    fs: VFS = dataclasses.field(default_factory=RealFS)
+    name: str = "process"
+    should_cache: bool = True
+
+    def labels(self, pid: int) -> dict[str, str]:
+        out: dict[str, str] = {}
+        try:
+            out["comm"] = self.fs.read_bytes(
+                f"/proc/{pid}/comm"
+            ).decode(errors="replace").strip()
+        except OSError:
+            pass
+        try:
+            # /proc/pid/exe is a symlink; the cmdline's argv[0] is the
+            # VFS-friendly stand-in (FakeFS has no symlinks).
+            cmdline = self.fs.read_bytes(f"/proc/{pid}/cmdline")
+            argv0 = cmdline.split(b"\x00", 1)[0].decode(errors="replace")
+            if argv0:
+                out["executable"] = argv0
+        except OSError:
+            pass
+        return out
+
+
+@dataclasses.dataclass
+class CgroupProvider:
+    """Primary cgroup path (reference cgroup.go:25-60)."""
+
+    fs: VFS = dataclasses.field(default_factory=RealFS)
+    name: str = "cgroup"
+    should_cache: bool = True
+
+    def labels(self, pid: int) -> dict[str, str]:
+        try:
+            data = self.fs.read_bytes(f"/proc/{pid}/cgroup")
+        except OSError:
+            return {}
+        # cgroup v2 line: "0::/path"; v1: "N:controller:/path" — prefer v2,
+        # else the cpu controller, else the first line.
+        best = None
+        for line in data.decode(errors="replace").splitlines():
+            parts = line.split(":", 2)
+            if len(parts) != 3:
+                continue
+            if parts[0] == "0" and parts[1] == "":
+                best = parts[2]
+                break
+            if best is None or "cpu" in parts[1].split(","):
+                best = parts[2]
+        return {"cgroup_name": best} if best else {}
+
+
+@dataclasses.dataclass
+class SystemProvider:
+    """Kernel release (reference system.go:41-90)."""
+
+    fs: VFS = dataclasses.field(default_factory=RealFS)
+    name: str = "system"
+    should_cache: bool = True
+
+    def labels(self, pid: int) -> dict[str, str]:
+        try:
+            rel = self.fs.read_bytes(
+                "/proc/sys/kernel/osrelease"
+            ).decode().strip()
+            return {"kernel_release": rel}
+        except OSError:
+            return {}
+
+
+_GO_BUILDINFO = re.compile(rb"\xff Go buildinf:")
+
+
+@dataclasses.dataclass
+class CompilerProvider:
+    """Compiler/runtime classification of the main executable (role of
+    reference compiler.go:48-80, which uses the ainur library): Go binaries
+    via the go build-id note / buildinfo magic, else C/C++; plus
+    static/stripped bits from the ELF structure."""
+
+    fs: VFS = dataclasses.field(default_factory=RealFS)
+    name: str = "compiler"
+    should_cache: bool = True
+
+    def labels(self, pid: int) -> dict[str, str]:
+        from parca_agent_tpu.elf.buildid import go_build_id
+        from parca_agent_tpu.elf.reader import ElfError, ElfFile
+
+        try:
+            # /proc/pid/exe is a symlink to the main executable; reading
+            # through it works on the real fs, and FakeFS tests key it
+            # directly.
+            data = self.fs.read_bytes(f"/proc/{pid}/exe")
+        except OSError:
+            return {}
+        try:
+            ef = ElfFile(data)
+        except ElfError:
+            return {}
+        is_go = go_build_id(ef) is not None or \
+            ef.section(".go.buildinfo") is not None
+        has_dynamic = any(s.name == ".dynamic" for s in ef.sections)
+        stripped = ef.section(".symtab") is None
+        return {
+            "compiler": "go" if is_go else "cc",
+            "static": str(not has_dynamic).lower(),
+            "stripped": str(stripped).lower(),
+        }
+
+
+@dataclasses.dataclass
+class TargetProvider:
+    """Node name + operator-supplied external labels (reference
+    target.go:24-45)."""
+
+    node: str = ""
+    external: dict[str, str] = dataclasses.field(default_factory=dict)
+    name: str = "target"
+    should_cache: bool = False  # cheap, and external labels can be reloaded
+
+    def labels(self, pid: int) -> dict[str, str]:
+        out = dict(self.external)
+        if self.node:
+            out["node"] = self.node
+        return out
+
+
+@dataclasses.dataclass
+class ServiceDiscoveryProvider:
+    """PID -> discovery group labels, fed by the discovery manager's state
+    (reference service_discovery.go:28+ consuming the SyncCh)."""
+
+    name: str = "service_discovery"
+    should_cache: bool = False  # stateful; state IS the cache
+    _state: dict[int, dict[str, str]] = dataclasses.field(default_factory=dict)
+
+    def update(self, groups) -> None:
+        """groups: iterable of discovery.Group."""
+        state: dict[int, dict[str, str]] = {}
+        for g in groups:
+            for pid in g.pids:
+                state.setdefault(pid, {}).update(g.labels)
+        self._state = state
+
+    def labels(self, pid: int) -> dict[str, str]:
+        return dict(self._state.get(pid, {}))

@@ -1,0 +1,5 @@
+"""Profiler runtime (reference layer L1, pkg/profiler)."""
+
+from parca_agent_tpu.profiler.cpu import CPUProfiler, ProfilerMetrics
+
+__all__ = ["CPUProfiler", "ProfilerMetrics"]

@@ -1,0 +1,162 @@
+"""The CPU profiler actor: the 10-second iteration loop.
+
+Role of the reference's pkg/profiler/cpu/cpu.go Run + obtainProfiles
+(cpu.go:189-384): every profiling duration, drain the capture source into
+a WindowSnapshot, aggregate (pluggable backend — the north-star seam),
+symbolize kernel/JIT frames, label, encode pprof, write, and kick off
+debuginfo uploads. An iteration failure is non-fatal: logged, surfaced via
+last_error, and the loop continues (cpu.go:326-330, SURVEY.md section 5.3).
+
+The capture source protocol is `poll() -> WindowSnapshot | None` (replay,
+synthetic, or live sampler); `None` ends the run loop — the replay-driven
+agent exits cleanly after the last window, the live sampler never returns
+None while running.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Protocol
+
+from parca_agent_tpu.aggregator.base import Aggregator, PidProfile
+from parca_agent_tpu.capture.formats import WindowSnapshot
+from parca_agent_tpu.pprof.builder import build_pprof
+
+
+class CaptureSource(Protocol):
+    def poll(self) -> WindowSnapshot | None: ...
+
+
+@dataclasses.dataclass
+class ProfilerMetrics:
+    """Counter names mirror the reference's observable metric contract
+    (pkg/profiler/cpu/metrics.go:22-65, SURVEY.md section 5.5)."""
+
+    attempts_total: int = 0
+    errors_total: int = 0
+    profiles_written: int = 0
+    samples_aggregated: int = 0
+    last_attempt_duration_s: float = 0.0
+    last_symbolize_duration_s: float = 0.0
+    last_aggregate_duration_s: float = 0.0
+
+
+class CPUProfiler:
+    name = "cpu"
+
+    def __init__(
+        self,
+        source: CaptureSource,
+        aggregator: Aggregator,
+        symbolizer=None,
+        labels_manager=None,
+        profile_writer=None,
+        debuginfo=None,
+        duration_s: float = 10.0,
+        fallback_aggregator: Aggregator | None = None,
+        on_iteration: Callable[[int], None] | None = None,
+    ):
+        self._source = source
+        self._aggregator = aggregator
+        self._fallback = fallback_aggregator
+        self._symbolizer = symbolizer
+        self._labels = labels_manager
+        self._writer = profile_writer
+        self._debuginfo = debuginfo
+        self._duration = duration_s
+        self._on_iteration = on_iteration
+        self._stop = threading.Event()
+        self.metrics = ProfilerMetrics()
+        self.last_error: Exception | None = None
+        self.last_profile_started_at: float = 0.0
+        # pid -> profiled-ok flag for the status page (reference
+        # processLastErrors, cpu.go:461-471).
+        self.process_last_errors: dict[int, Exception | None] = {}
+
+    # -- one iteration ------------------------------------------------------
+
+    def obtain_profiles(self, snapshot: WindowSnapshot) -> list[PidProfile]:
+        """Aggregate with the configured backend; fall back to the CPU path
+        when the device backend fails (SURVEY.md section 7 hard part #5:
+        device trouble must not stall the capture loop)."""
+        t0 = time.perf_counter()
+        try:
+            profiles = self._aggregator.aggregate(snapshot)
+        except Exception:
+            if self._fallback is None:
+                raise
+            profiles = self._fallback.aggregate(snapshot)
+        self.metrics.last_aggregate_duration_s = time.perf_counter() - t0
+        return profiles
+
+    def run_iteration(self) -> bool:
+        """Returns False when the source is exhausted."""
+        snapshot = self._source.poll()
+        if snapshot is None:
+            return False
+        self.last_profile_started_at = time.time()
+        self.metrics.attempts_total += 1
+        t_start = time.perf_counter()
+        try:
+            profiles = self.obtain_profiles(snapshot)
+            self.metrics.samples_aggregated += snapshot.total_samples()
+
+            if self._symbolizer is not None:
+                t0 = time.perf_counter()
+                self._symbolizer.symbolize(profiles)
+                self.metrics.last_symbolize_duration_s = time.perf_counter() - t0
+
+            for prof in profiles:
+                self._write_profile(prof)
+
+            if self._debuginfo is not None:
+                objs = []
+                mt = snapshot.mappings
+                for i, path in enumerate(mt.obj_paths):
+                    bid = mt.obj_buildids[i] if i < len(mt.obj_buildids) else ""
+                    rows = (mt.objs == i).nonzero()[0]
+                    if len(rows) and path:
+                        pid = int(mt.pids[rows[0]])
+                        objs.append((pid, path, bid))
+                self._debuginfo.ensure_uploaded(objs)
+            self.last_error = None
+        except Exception as e:  # non-fatal (cpu.go:326-330)
+            self.last_error = e
+            self.metrics.errors_total += 1
+        self.metrics.last_attempt_duration_s = time.perf_counter() - t_start
+        if self._on_iteration is not None:
+            self._on_iteration(self.metrics.attempts_total)
+        return True
+
+    def _write_profile(self, prof: PidProfile) -> None:
+        labels = None
+        if self._labels is not None:
+            labels = self._labels.label_set("parca_agent_cpu", prof.pid)
+            if labels is None:
+                self.process_last_errors[prof.pid] = None
+                return  # relabeling dropped this target
+        if labels is None:
+            labels = {"__name__": "parca_agent_cpu", "pid": str(prof.pid)}
+        try:
+            if self._writer is not None:
+                self._writer.write(labels, build_pprof(prof))
+            self.metrics.profiles_written += 1
+            self.process_last_errors[prof.pid] = None
+        except Exception as e:
+            self.process_last_errors[prof.pid] = e
+            raise
+
+    # -- actor --------------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            if not self.run_iteration():
+                return
+            elapsed = time.monotonic() - t0
+            self._stop.wait(max(0.0, self._duration - elapsed))
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,159 @@
+"""HTTP surface: status page, metrics, live query, health.
+
+Role of the reference's mux in cmd/parca-agent/main.go:269-503 and the
+status template in pkg/template: `/` renders active profilers and
+per-process profiling state with query links; `/metrics` serves Prometheus
+text exposition; `/query` returns the next matching raw profile (backed by
+the MatchingProfileListener); `/healthy` is the liveness probe. Built on
+http.server (stdlib) so the shell has zero web dependencies.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def render_status_page(profilers, version: str = "dev") -> str:
+    rows = []
+    for p in profilers:
+        rows.append(
+            f"<h2>{html.escape(p.name)}</h2>"
+            f"<p>attempts: {p.metrics.attempts_total}, "
+            f"errors: {p.metrics.errors_total}, "
+            f"profiles written: {p.metrics.profiles_written}, "
+            f"samples: {p.metrics.samples_aggregated}</p>"
+            f"<p>last error: "
+            f"{html.escape('' if p.last_error is None else str(p.last_error))}"
+            f"</p>"
+        )
+        procs = []
+        for pid, err in sorted(p.process_last_errors.items()):
+            state = "ok" if err is None else html.escape(str(err))
+            procs.append(
+                f"<tr><td>{pid}</td><td>{state}</td>"
+                f"<td><a href='/query?pid={pid}'>profile</a></td></tr>"
+            )
+        if procs:
+            rows.append(
+                "<table><tr><th>pid</th><th>state</th><th></th></tr>"
+                + "".join(procs) + "</table>"
+            )
+    return (
+        "<!doctype html><html><head><title>parca-agent-tpu</title></head>"
+        f"<body><h1>parca-agent-tpu ({html.escape(version)})</h1>"
+        + "".join(rows) + "</body></html>"
+    )
+
+
+def render_metrics(profilers, batch_client=None, extra: dict | None = None) -> str:
+    """Prometheus text exposition of the first-party metric contract
+    (SURVEY.md section 5.5), plus the north-star aggregation metrics."""
+    lines = []
+
+    def emit(name, value, labels=""):
+        lines.append(f"{name}{labels} {value}")
+
+    for p in profilers:
+        lab = f'{{profiler="{p.name}"}}'
+        emit("parca_agent_profiler_attempts_total", p.metrics.attempts_total, lab)
+        emit("parca_agent_profiler_errors_total", p.metrics.errors_total, lab)
+        emit("parca_agent_profiler_profiles_written_total",
+             p.metrics.profiles_written, lab)
+        emit("parca_agent_profiler_samples_aggregated_total",
+             p.metrics.samples_aggregated, lab)
+        emit("parca_agent_profiler_attempt_duration_seconds",
+             p.metrics.last_attempt_duration_s, lab)
+        emit("parca_agent_profiler_symbolize_duration_seconds",
+             p.metrics.last_symbolize_duration_s, lab)
+        emit("parca_agent_profiler_aggregate_duration_seconds",
+             p.metrics.last_aggregate_duration_s, lab)
+    if batch_client is not None:
+        emit("parca_agent_remote_write_batches_sent_total",
+             batch_client.sent_batches)
+        emit("parca_agent_remote_write_errors_total", batch_client.send_errors)
+    for k, v in (extra or {}).items():
+        emit(k, v)
+    return "\n".join(lines) + "\n"
+
+
+class AgentHTTPServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 7071,
+                 profilers=(), batch_client=None, listener=None,
+                 version: str = "dev", extra_metrics=None):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, body: bytes, ctype="text/plain"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urllib.parse.urlparse(self.path)
+                if url.path == "/":
+                    self._send(200, render_status_page(
+                        outer.profilers, outer.version).encode(), "text/html")
+                elif url.path == "/metrics":
+                    extra = outer.extra_metrics() if outer.extra_metrics else {}
+                    self._send(200, render_metrics(
+                        outer.profilers, outer.batch_client, extra).encode())
+                elif url.path == "/healthy":
+                    self._send(200, b"ok\n")
+                elif url.path == "/query":
+                    self._query(url)
+                else:
+                    self._send(404, b"not found\n")
+
+            def _query(self, url):
+                if outer.listener is None:
+                    self._send(503, b"no listener\n")
+                    return
+                params = dict(urllib.parse.parse_qsl(url.query))
+                timeout = float(params.pop("timeout", "15"))
+                want = params
+
+                def match(labels):
+                    return all(labels.get(k) == v for k, v in want.items())
+
+                got = outer.listener.next_matching_profile(match, timeout)
+                if got is None:
+                    self._send(404, b"no matching profile observed\n")
+                    return
+                labels, sample = got
+                body = json.dumps({"labels": labels}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("X-Profile-Labels", body.decode())
+                self.send_header("Content-Length", str(len(sample)))
+                self.end_headers()
+                self.wfile.write(sample)
+
+        self.profilers = list(profilers)
+        self.batch_client = batch_client
+        self.listener = listener
+        self.version = version
+        self.extra_metrics = extra_metrics
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)

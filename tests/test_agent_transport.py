@@ -1,0 +1,204 @@
+"""Transport tests: proto round-trip, batch semantics, listener tee,
+writers, and a live in-process gRPC loopback."""
+
+import gzip
+import threading
+import time
+
+import pytest
+
+from parca_agent_tpu.agent.batch import BatchWriteClient, NoopStoreClient
+from parca_agent_tpu.agent.listener import MatchingProfileListener, equals_matcher
+from parca_agent_tpu.agent.profilestore import (
+    RawSeries,
+    decode_write_raw_request,
+    encode_write_raw_request,
+)
+from parca_agent_tpu.agent.writer import FileProfileWriter, RemoteProfileWriter
+
+
+def test_write_raw_request_roundtrip():
+    series = [
+        RawSeries({"__name__": "cpu", "pid": "7"}, [b"profile-a", b"profile-b"]),
+        RawSeries({"node": "n1"}, [b"x"]),
+    ]
+    blob = encode_write_raw_request(series, normalized=True)
+    out, normalized = decode_write_raw_request(blob)
+    assert normalized is True
+    assert [s.labels for s in out] == [s.labels for s in series]
+    assert [s.samples for s in out] == [s.samples for s in series]
+
+
+class RecordingStore:
+    def __init__(self, fail_times=0):
+        self.batches = []
+        self.fail_times = fail_times
+
+    def write_raw(self, series, normalized):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise ConnectionError("boom")
+        self.batches.append([RawSeries(dict(s.labels), list(s.samples))
+                             for s in series])
+
+
+def test_batch_merges_by_labelset():
+    store = RecordingStore()
+    c = BatchWriteClient(store)
+    c.write_raw({"pid": "1"}, b"a")
+    c.write_raw({"pid": "1"}, b"b")
+    c.write_raw({"pid": "2"}, b"c")
+    assert c.flush()
+    (batch,) = store.batches
+    by_pid = {s.labels["pid"]: s.samples for s in batch}
+    assert by_pid == {"1": [b"a", b"b"], "2": [b"c"]}
+
+
+def test_batch_retries_with_backoff_then_succeeds():
+    store = RecordingStore(fail_times=2)
+    slept = []
+    c = BatchWriteClient(store, interval_s=10.0, initial_backoff_s=0.1,
+                         sleep=slept.append)
+    c.write_raw({"pid": "1"}, b"a")
+    assert c.flush()
+    assert slept == [0.1, 0.2]  # exponential
+    assert c.send_errors == 2 and c.sent_batches == 1
+
+
+def test_batch_failure_restores_buffer():
+    store = RecordingStore(fail_times=99)
+    clock = [0.0]
+
+    def sleep(s):
+        clock[0] += s
+
+    c = BatchWriteClient(store, interval_s=1.0, initial_backoff_s=0.4,
+                         clock=lambda: clock[0], sleep=sleep)
+    c.write_raw({"pid": "1"}, b"a")
+    assert not c.flush()
+    # New sample arrives, then the store recovers: both ship together.
+    store.fail_times = 0
+    c.write_raw({"pid": "1"}, b"b")
+    assert c.flush()
+    (batch,) = store.batches
+    assert batch[0].samples == [b"a", b"b"]
+
+
+def test_batch_run_loop_drains_on_stop():
+    store = RecordingStore()
+    c = BatchWriteClient(store, interval_s=30.0)
+    t = threading.Thread(target=c.run, daemon=True)
+    t.start()
+    c.write_raw({"pid": "9"}, b"z")
+    c.stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert store.batches and store.batches[0][0].samples == [b"z"]
+
+
+def test_listener_tee_and_matching():
+    store = RecordingStore()
+    batch = BatchWriteClient(store)
+    listener = MatchingProfileListener(next_writer=batch)
+
+    got = {}
+
+    def wait():
+        got["r"] = listener.next_matching_profile(
+            equals_matcher(pid="7"), timeout=5
+        )
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.05)
+    listener.write_raw({"pid": "6"}, b"no")
+    listener.write_raw({"pid": "7"}, b"yes")
+    t.join(timeout=5)
+    labels, sample = got["r"]
+    assert sample == b"yes" and labels["pid"] == "7"
+    # tee passed everything through
+    assert batch.flush()
+    assert sum(len(s.samples) for s in store.batches[0]) == 2
+
+
+def test_listener_timeout():
+    listener = MatchingProfileListener()
+    assert listener.next_matching_profile(equals_matcher(pid="1"),
+                                          timeout=0.05) is None
+    listener.write_raw({"pid": "1"}, b"later")  # no observer anymore: no-op
+
+
+def test_file_writer(tmp_path):
+    w = FileProfileWriter(str(tmp_path))
+    w.write_raw({"__name__": "cpu", "comm": "app", "pid": "3"}, b"gzbytes")
+    files = list(tmp_path.iterdir())
+    assert len(files) == 1
+    assert files[0].name.startswith("comm=app_pid=3.")
+    assert files[0].read_bytes() == b"gzbytes"
+
+
+def test_remote_writer_gzips():
+    listener = MatchingProfileListener()
+    rw = RemoteProfileWriter(listener)
+
+    got = {}
+
+    def wait():
+        got["r"] = listener.next_matching_profile(lambda _: True, timeout=5)
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.05)
+    rw.write({"pid": "1"}, b"raw-pprof")
+    t.join(timeout=5)
+    _, sample = got["r"]
+    assert gzip.decompress(sample) == b"raw-pprof"
+
+
+def test_noop_store_client():
+    NoopStoreClient().write_raw([], normalized=True)
+
+
+def test_grpc_loopback():
+    """End-to-end WriteRaw over a real in-process gRPC server."""
+    grpc = pytest.importorskip("grpc")
+    from concurrent import futures
+
+    from parca_agent_tpu.agent.grpc_client import (
+        WRITE_RAW_METHOD,
+        GRPCStoreClient,
+    )
+
+    received = {}
+
+    def handler(request, context):
+        received["series"], received["normalized"] = \
+            decode_write_raw_request(request)
+        md = dict(context.invocation_metadata())
+        received["auth"] = md.get("authorization", "")
+        return b""
+
+    method = WRITE_RAW_METHOD.rsplit("/", 1)
+    service = grpc.method_handlers_generic_handler(
+        method[0].lstrip("/"),
+        {method[1]: grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )},
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((service,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        client = GRPCStoreClient(f"127.0.0.1:{port}", insecure=True,
+                                 bearer_token="tok", timeout_s=10)
+        client.write_raw([RawSeries({"pid": "5"}, [b"pp"])], normalized=True)
+        client.close()
+    finally:
+        server.stop(0)
+    assert received["series"][0].labels == {"pid": "5"}
+    assert received["series"][0].samples == [b"pp"]
+    assert received["normalized"] is True
+    assert received["auth"] == "Bearer tok"

@@ -1,0 +1,165 @@
+"""Debuginfo subsystem tests: ELF writer round-trip, finder, manager."""
+
+import struct
+import subprocess
+import zlib
+
+import pytest
+
+from parca_agent_tpu.debuginfo.extract import extract_debuginfo
+from parca_agent_tpu.debuginfo.find import Finder, debuglink
+from parca_agent_tpu.debuginfo.manager import DebuginfoManager, NoopClient
+from parca_agent_tpu.elf.buildid import gnu_build_id
+from parca_agent_tpu.elf.reader import ElfFile
+from parca_agent_tpu.elf.writer import filter_elf
+from parca_agent_tpu.utils.vfs import FakeFS
+
+
+@pytest.fixture(scope="session")
+def binary(tmp_path_factory):
+    d = tmp_path_factory.mktemp("dbg")
+    src = d / "p.c"
+    src.write_text("""
+int global_counter = 7;
+__attribute__((noinline)) int work(int x) { return x * 2 + global_counter; }
+int main(void) { return work(5); }
+""")
+    out = d / "p"
+    subprocess.run(["gcc", "-g", "-O0", "-Wl,--build-id=sha1",
+                    str(src), "-o", str(out)], check=True, capture_output=True)
+    return out.read_bytes()
+
+
+def test_filter_elf_roundtrip(binary):
+    stripped = filter_elf(binary, lambda s: s.name.startswith(".debug_")
+                          or s.name in (".symtab", ".strtab"))
+    ef = ElfFile(stripped)
+    names = [s.name for s in ef.sections]
+    assert ".symtab" in names and ".strtab" in names
+    assert any(n.startswith(".debug_") for n in names)
+    assert ".text" not in names
+    # Symbols remain readable and link remap worked (names resolve).
+    syms = {s.name for s in ef.symbols()}
+    assert "work" in syms and "main" in syms
+    # Strictly smaller than the input.
+    assert len(stripped) < len(binary)
+
+
+def test_extract_keeps_notes_and_debug(binary):
+    out = extract_debuginfo(binary)
+    ef = ElfFile(out)
+    names = [s.name for s in ef.sections]
+    assert any(n.startswith(".note.gnu.build-id") for n in names)
+    assert any(n.startswith(".debug_info") for n in names)
+    # Build id survives extraction (upload key integrity).
+    assert gnu_build_id(ef) == gnu_build_id(ElfFile(binary))
+    # Section data identical to the source for a kept section.
+    src_ef = ElfFile(binary)
+    for name in (".debug_info", ".symtab"):
+        a = ef.section_data(ef.section(name))
+        b = src_ef.section_data(src_ef.section(name))
+        assert a == b
+
+
+def test_debuglink_parse():
+    # Synthesize a .gnu_debuglink payload: name + pad + crc
+    payload = b"prog.debug\x00\x00" + struct.pack("<I", 0xDEADBEEF)
+    # Build a minimal elf with that section via the writer
+    from parca_agent_tpu.elf.reader import Section
+    from parca_agent_tpu.elf.writer import ElfWriter
+
+    w = ElfWriter(2, 0x3E)
+    w.add_section(Section(".gnu_debuglink", 1, 0, 0, 0, len(payload), 0, 0, 4, 0),
+                  payload)
+    ef = ElfFile(w.serialize())
+    assert debuglink(ef) == ("prog.debug", 0xDEADBEEF)
+
+
+def test_finder_build_id_path(binary):
+    bid = gnu_build_id(ElfFile(binary))
+    fs = FakeFS({
+        f"/proc/9/root/usr/lib/debug/.build-id/{bid[:2]}/{bid[2:]}.debug": b"x",
+        "/proc/9/root/app/prog": binary,
+    })
+    f = Finder(fs=fs)
+    assert f.find(9, "/app/prog") == \
+        f"/proc/9/root/usr/lib/debug/.build-id/{bid[:2]}/{bid[2:]}.debug"
+
+
+def test_finder_debuglink_crc(binary):
+    dbg = extract_debuginfo(binary)
+    crc = zlib.crc32(dbg)
+    link_payload = b"prog.debug\x00\x00" + struct.pack("<I", crc)
+    from parca_agent_tpu.elf.reader import Section
+    from parca_agent_tpu.elf.writer import ElfWriter
+
+    w = ElfWriter(2, 0x3E)
+    w.add_section(Section(".gnu_debuglink", 1, 0, 0, 0, len(link_payload),
+                          0, 0, 4, 0), link_payload)
+    host_binary = w.serialize()
+    fs = FakeFS({
+        "/proc/9/root/app/prog": host_binary,
+        "/proc/9/root/app/prog.debug": b"wrong-crc",  # rejected
+        "/proc/9/root/app/.debug/prog.debug": dbg,    # crc matches
+    })
+    found = Finder(fs=fs).find(9, "/app/prog")
+    assert found == "/proc/9/root/app/.debug/prog.debug"
+
+
+class RecordingClient:
+    def __init__(self, existing=()):
+        self.existing = set(existing)
+        self.uploads = []
+
+    def exists(self, build_id, hash_):
+        return build_id in self.existing
+
+    def upload(self, build_id, hash_, data):
+        self.uploads.append((build_id, len(data)))
+        self.existing.add(build_id)
+
+
+def test_manager_uploads_once(binary):
+    bid = gnu_build_id(ElfFile(binary))
+    fs = FakeFS({"/proc/9/root/app/prog": binary})
+    client = RecordingClient()
+    mgr = DebuginfoManager(client=client, fs=fs)
+    objs = [(9, "/app/prog", bid)]
+    mgr.ensure_uploaded(objs)
+    mgr.ensure_uploaded(objs)  # second window: deduped
+    mgr.drain()
+    mgr.ensure_uploaded(objs)  # third window: exists-cache hit
+    mgr.close()
+    assert len(client.uploads) == 1
+    assert client.uploads[0][0] == bid
+    assert mgr.stats.uploaded == 1 and mgr.stats.extracted == 1
+    # Uploaded payload was the extracted ELF (smaller), not the raw binary.
+    assert client.uploads[0][1] < len(binary)
+
+
+def test_manager_exists_short_circuit(binary):
+    bid = gnu_build_id(ElfFile(binary))
+    fs = FakeFS({"/proc/9/root/app/prog": binary})
+    client = RecordingClient(existing=[bid])
+    mgr = DebuginfoManager(client=client, fs=fs)
+    mgr.ensure_uploaded([(9, "/app/prog", bid)])
+    mgr.close()
+    assert client.uploads == []
+    assert mgr.stats.already_present == 1
+
+
+def test_manager_unreadable_marks_failed():
+    mgr = DebuginfoManager(client=RecordingClient(), fs=FakeFS({}))
+    mgr.ensure_uploaded([(9, "/gone", "abcd")])
+    mgr.close()
+    assert mgr.stats.errors == 1
+    # Not retried next window.
+    mgr2_calls = len(mgr._uploading)
+    mgr.ensure_uploaded([(9, "/gone", "abcd")])
+    assert len(mgr._uploading) == mgr2_calls
+
+
+def test_noop_client():
+    c = NoopClient()
+    assert c.exists("x", "y") is True
+    c.upload("x", "y", b"data")

@@ -1,0 +1,141 @@
+"""Discovery manager + discoverer tests (no systemd/k8s required)."""
+
+import threading
+import time
+
+from parca_agent_tpu.discovery.cgroup import (
+    CgroupContainerDiscoverer,
+    parse_container_cgroup,
+)
+from parca_agent_tpu.discovery.manager import DiscoveryManager, Group
+from parca_agent_tpu.discovery.systemd import SystemdDiscoverer
+from parca_agent_tpu.utils.vfs import FakeFS
+
+CID = "a" * 64
+CID2 = "b" * 64
+
+
+def test_parse_container_cgroup():
+    text = (f"0::/kubepods.slice/kubepods-pod12345678_1234_1234_1234_"
+            f"123456789012.slice/cri-containerd-{CID}.scope\n")
+    labels = parse_container_cgroup(text)
+    assert labels["containerid"] == CID
+    assert labels["pod_uid"] == "12345678-1234-1234-1234-123456789012"
+    assert parse_container_cgroup("0::/user.slice\n") == {}
+
+
+def test_cgroup_discoverer_groups_by_container():
+    fs = FakeFS({
+        "/proc/10/cgroup": f"0::/docker/{CID}\n".encode(),
+        "/proc/11/cgroup": f"0::/docker/{CID}\n".encode(),
+        "/proc/12/cgroup": f"0::/docker/{CID2}\n".encode(),
+        "/proc/13/cgroup": b"0::/user.slice\n",
+        "/proc/self/cgroup": b"ignored\n",
+    })
+    groups = CgroupContainerDiscoverer(fs=fs).scrape()
+    by_cid = {g.labels["containerid"]: g for g in groups}
+    assert sorted(by_cid[CID].pids) == [10, 11]
+    assert by_cid[CID].entry_pid == 10
+    assert by_cid[CID2].pids == [12]
+
+
+def test_systemd_discoverer_with_fake_runner():
+    calls = []
+
+    def runner(args):
+        calls.append(args)
+        if args[0] == "list-units":
+            return "nginx.service loaded active running\nsshd.service loaded active running\n"
+        # Batched `show`: blank-line-separated values in argument order.
+        assert args[:4] == ["show", "-p", "MainPID", "--value"]
+        assert args[4:] == ["nginx.service", "sshd.service"]
+        return "101\n\n0\n"
+
+    groups = SystemdDiscoverer(runner=runner).scrape()
+    assert len(calls) == 2  # one list + one batched show
+    assert len(groups) == 1  # sshd has MainPID 0 -> skipped
+    assert groups[0].labels == {"systemd_unit": "nginx.service"}
+    assert groups[0].pids == [101]
+
+
+def test_manager_merges_and_versions():
+    mgr = DiscoveryManager(debounce_s=0.0)
+
+    class OneShot:
+        def __init__(self, groups):
+            self._groups = groups
+
+        def run(self, stop, up):
+            up(self._groups)
+
+    mgr.apply_config({
+        "a": OneShot([Group(source="a/1", labels={"x": "1"}, pids=[1])]),
+        "b": OneShot([Group(source="b/1", labels={"y": "2"}, pids=[2])]),
+    })
+    v0 = mgr.version
+    mgr.run()
+    v = mgr.wait_for_update(v0, timeout=5)
+    assert v > v0
+    # Both providers eventually publish; poll briefly for the second.
+    deadline = time.monotonic() + 5
+    while len(mgr.groups()) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sources = {g.source for g in mgr.groups()}
+    assert sources == {"a/1", "b/1"}
+    mgr.stop()
+
+
+def test_manager_group_update_replaces_source():
+    mgr = DiscoveryManager(debounce_s=0.0)
+    mgr._update("p", [Group(source="s", pids=[1])])
+    mgr._update("p", [Group(source="s", pids=[1, 2])])
+    mgr.flush()
+    (g,) = mgr.groups()
+    assert g.pids == [1, 2]
+
+
+def test_manager_debounce_defers_publish():
+    mgr = DiscoveryManager(debounce_s=3600.0)
+    mgr._update("p", [Group(source="s", pids=[1])])
+    # First update publishes immediately (last_publish was 0); the second
+    # within the window stays pending.
+    v = mgr.version
+    mgr._update("p", [Group(source="s", pids=[1, 2])])
+    assert mgr.version == v
+    mgr.flush()
+    assert mgr.version == v + 1
+    (g,) = mgr.groups()
+    assert g.pids == [1, 2]
+
+
+def test_failed_provider_counted():
+    mgr = DiscoveryManager()
+
+    class Boom:
+        def run(self, stop, up):
+            raise RuntimeError("x")
+
+    mgr.apply_config({"boom": Boom()})
+    mgr.run()
+    deadline = time.monotonic() + 5
+    while mgr.failed_updates == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mgr.failed_updates == 1
+    mgr.stop()
+
+
+def test_end_to_end_discovery_to_labels():
+    """Discovery groups flow into the ServiceDiscoveryProvider and out
+    through the labels manager (reference call stack section 3.5)."""
+    from parca_agent_tpu.labels.manager import LabelsManager
+    from parca_agent_tpu.metadata.providers import ServiceDiscoveryProvider
+
+    mgr = DiscoveryManager(debounce_s=0.0)
+    mgr._update("cgroup", [Group(source=f"cgroup/{CID}",
+                                 labels={"containerid": CID}, pids=[44])])
+    mgr.flush()
+    sd = ServiceDiscoveryProvider()
+    sd.update(mgr.groups())
+    labels = LabelsManager([sd], []).label_set("cpu", 44)
+    assert labels["containerid"] == CID
+    assert LabelsManager([sd], []).label_set("cpu", 45)["pid"] == "45"

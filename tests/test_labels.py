@@ -1,0 +1,191 @@
+"""Relabel semantics + metadata providers + labels manager tests."""
+
+from parca_agent_tpu.discovery.manager import Group
+from parca_agent_tpu.labels.manager import LabelsManager
+from parca_agent_tpu.labels.relabel import RelabelConfig, process
+from parca_agent_tpu.metadata.providers import (
+    CgroupProvider,
+    ProcessProvider,
+    ServiceDiscoveryProvider,
+    SystemProvider,
+    TargetProvider,
+)
+from parca_agent_tpu.utils.vfs import FakeFS
+
+
+def rc(**kw):
+    return RelabelConfig.from_dict(kw)
+
+
+def test_relabel_replace():
+    out = process(
+        {"comm": "nginx", "pid": "7"},
+        [rc(action="replace", source_labels=["comm"], regex="ngin(.)",
+            target_label="svc", replacement="web-$1")],
+    )
+    assert out["svc"] == "web-x"
+
+
+def test_relabel_replace_no_match_keeps():
+    out = process(
+        {"comm": "redis"},
+        [rc(action="replace", source_labels=["comm"], regex="nginx",
+            target_label="svc", replacement="web")],
+    )
+    assert "svc" not in out
+
+
+def test_relabel_keep_drop():
+    cfgs = [rc(action="keep", source_labels=["comm"], regex="nginx|redis")]
+    assert process({"comm": "nginx"}, cfgs) is not None
+    assert process({"comm": "java"}, cfgs) is None
+    cfgs = [rc(action="drop", source_labels=["comm"], regex="java.*")]
+    assert process({"comm": "java8"}, cfgs) is None
+    assert process({"comm": "nginx"}, cfgs) is not None
+
+
+def test_relabel_regex_is_anchored():
+    # Prometheus anchors both ends: "inx" must NOT match "nginx".
+    cfgs = [rc(action="keep", source_labels=["comm"], regex="inx")]
+    assert process({"comm": "nginx"}, cfgs) is None
+
+
+def test_relabel_multiple_sources_separator():
+    out = process(
+        {"a": "x", "b": "y"},
+        [rc(action="replace", source_labels=["a", "b"], separator="/",
+            regex="x/y", target_label="ab", replacement="matched")],
+    )
+    assert out["ab"] == "matched"
+
+
+def test_relabel_hashmod_stable():
+    cfgs = [rc(action="hashmod", source_labels=["pid"], modulus=4,
+               target_label="shard")]
+    a = process({"pid": "123"}, cfgs)["shard"]
+    b = process({"pid": "123"}, cfgs)["shard"]
+    assert a == b and 0 <= int(a) < 4
+
+
+def test_relabel_labelmap():
+    out = process(
+        {"__meta_kubernetes_pod_label_app": "web", "keep_me": "1"},
+        [rc(action="labelmap", regex="__meta_kubernetes_pod_label_(.+)")],
+    )
+    assert out["app"] == "web" and out["keep_me"] == "1"
+
+
+def test_relabel_labeldrop_labelkeep():
+    out = process(
+        {"tmp_a": "1", "b": "2"},
+        [rc(action="labeldrop", regex="tmp_.*")],
+    )
+    assert out == {"b": "2"}
+    out = process(
+        {"tmp_a": "1", "b": "2"},
+        [rc(action="labelkeep", regex="tmp_.*")],
+    )
+    assert out == {"tmp_a": "1"}
+
+
+def test_relabel_case_actions():
+    out = process(
+        {"comm": "NgInX"},
+        [rc(action="lowercase", source_labels=["comm"], target_label="comm")],
+    )
+    assert out["comm"] == "nginx"
+
+
+def test_relabel_empty_replacement_removes_label():
+    out = process(
+        {"drop_me": "x", "keep": "1"},
+        [rc(action="replace", source_labels=["missing"], regex="(.*)",
+            target_label="drop_me", replacement="$1")],
+    )
+    assert "drop_me" not in out
+
+
+def test_providers_from_fake_procfs():
+    fs = FakeFS({
+        "/proc/42/comm": b"worker\n",
+        "/proc/42/cmdline": b"/app/bin/worker\x00--flag\x00",
+        "/proc/42/cgroup": b"0::/kubepods/pod1/abc\n",
+        "/proc/sys/kernel/osrelease": b"6.6.1-test\n",
+    })
+    assert ProcessProvider(fs=fs).labels(42) == {
+        "comm": "worker", "executable": "/app/bin/worker",
+    }
+    assert CgroupProvider(fs=fs).labels(42) == {
+        "cgroup_name": "/kubepods/pod1/abc",
+    }
+    assert SystemProvider(fs=fs).labels(42) == {"kernel_release": "6.6.1-test"}
+    assert ProcessProvider(fs=FakeFS({})).labels(1) == {}
+
+
+def test_cgroup_v1_fallback():
+    fs = FakeFS({
+        "/proc/9/cgroup": b"4:memory:/m\n2:cpu,cpuacct:/docker/abc\n",
+    })
+    assert CgroupProvider(fs=fs).labels(9)["cgroup_name"] == "/docker/abc"
+
+
+def test_service_discovery_provider():
+    sd = ServiceDiscoveryProvider()
+    sd.update([Group(source="s", labels={"pod": "p1"}, pids=[5, 6])])
+    assert sd.labels(5) == {"pod": "p1"}
+    assert sd.labels(7) == {}
+
+
+def test_labels_manager_merge_relabel_and_cache():
+    clock = [0.0]
+    fs = FakeFS({"/proc/5/comm": b"nginx\n", "/proc/5/cmdline": b"nginx\x00"})
+    calls = {"n": 0}
+
+    class CountingProvider(ProcessProvider):
+        def labels(self, pid):
+            calls["n"] += 1
+            return super().labels(pid)
+
+    mgr = LabelsManager(
+        [CountingProvider(fs=fs), TargetProvider(node="n1")],
+        [RelabelConfig.from_dict({
+            "action": "drop", "source_labels": ["comm"], "regex": "java",
+        })],
+        profiling_duration_s=10.0,
+        clock=lambda: clock[0],
+    )
+    ls = mgr.label_set("cpu", 5)
+    assert ls["comm"] == "nginx" and ls["node"] == "n1"
+    assert ls["__name__"] == "cpu" and ls["pid"] == "5"
+    # label_set cache: no provider re-call within 3x duration
+    mgr.label_set("cpu", 5)
+    assert calls["n"] == 1
+    # label cache expires at 30s but provider cache (600s) still holds
+    clock[0] = 31.0
+    mgr.label_set("cpu", 5)
+    assert calls["n"] == 1
+    clock[0] = 601.0
+    mgr.label_set("cpu", 5)
+    assert calls["n"] == 2
+
+
+def test_labels_manager_drop_cached():
+    fs = FakeFS({"/proc/5/comm": b"java\n"})
+    mgr = LabelsManager(
+        [ProcessProvider(fs=fs)],
+        [RelabelConfig.from_dict({
+            "action": "drop", "source_labels": ["comm"], "regex": "java",
+        })],
+    )
+    assert mgr.label_set("cpu", 5) is None
+    assert mgr.label_set("cpu", 5) is None  # cached drop
+
+
+def test_labels_manager_apply_config_clears_cache():
+    fs = FakeFS({"/proc/5/comm": b"java\n"})
+    mgr = LabelsManager([ProcessProvider(fs=fs)], [])
+    assert mgr.label_set("cpu", 5) is not None
+    mgr.apply_config([RelabelConfig.from_dict({
+        "action": "drop", "source_labels": ["comm"], "regex": "java",
+    })])
+    assert mgr.label_set("cpu", 5) is None
