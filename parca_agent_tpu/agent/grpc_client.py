@@ -33,6 +33,9 @@ class GRPCStoreClient:
                 creds = grpc.composite_channel_credentials(creds, call_creds)
             self._channel = grpc.secure_channel(address, creds)
         self._bearer = bearer_token if insecure else ""
+        # Shared by the debuginfo client (one connection per server, like
+        # the reference's single grpcConn, main.go:595-656).
+        self.channel = self._channel
         self._write_raw = self._channel.unary_unary(
             WRITE_RAW_METHOD,
             request_serializer=lambda b: b,

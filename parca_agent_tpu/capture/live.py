@@ -1,0 +1,201 @@
+"""Live capture source over the native perf_event sampler.
+
+Python side of parca_agent_tpu/native/sampler.cc (the capture role of the
+reference's pkg/profiler/cpu/cpu.go:234-275 perf_event_open + attach): the
+shared library is built on demand with the local toolchain, loaded via
+ctypes, and drained once per window. Raw records are decoded with numpy,
+deduplicated into (pid, stack) -> count rows (the aggregation the
+reference's BPF map does kernel-side happens here, vectorized), and joined
+with the live /proc mapping table.
+
+Record format (sampler.cc): u32 pid | u32 tid | u32 n_kernel | u32 n_user
+| u64 frames[n_kernel + n_user] (kernel-first; we store user-first in the
+snapshot per the formats.py contract).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import time
+
+import numpy as np
+
+from parca_agent_tpu.capture.formats import (
+    MAX_STACK_DEPTH,
+    STACK_SLOTS,
+    MappingTable,
+    WindowSnapshot,
+)
+from parca_agent_tpu.process.maps import ProcessMapCache, build_mapping_table
+from parca_agent_tpu.process.objectfile import ObjectFileCache
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_LIB = os.path.join(_NATIVE_DIR, "libpasampler.so")
+
+
+class SamplerUnavailable(RuntimeError):
+    pass
+
+
+def build_native(force: bool = False) -> str:
+    """Compile libpasampler.so if missing; returns its path."""
+    src = os.path.join(_NATIVE_DIR, "sampler.cc")
+    if force or not os.path.exists(_LIB) or \
+            os.path.getmtime(_LIB) < os.path.getmtime(src):
+        r = subprocess.run(["make", "-C", _NATIVE_DIR, "libpasampler.so"],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            raise SamplerUnavailable(f"native build failed:\n{r.stderr}")
+    return _LIB
+
+
+def load_native():
+    lib = ctypes.CDLL(build_native(), use_errno=True)
+    lib.pa_sampler_create.restype = ctypes.c_void_p
+    lib.pa_sampler_create.argtypes = [ctypes.c_int]
+    lib.pa_sampler_n_cpus.restype = ctypes.c_int
+    lib.pa_sampler_n_cpus.argtypes = [ctypes.c_void_p]
+    lib.pa_sampler_lost.restype = ctypes.c_uint64
+    lib.pa_sampler_lost.argtypes = [ctypes.c_void_p]
+    lib.pa_sampler_start.restype = ctypes.c_int
+    lib.pa_sampler_start.argtypes = [ctypes.c_void_p]
+    lib.pa_sampler_stop.restype = ctypes.c_int
+    lib.pa_sampler_stop.argtypes = [ctypes.c_void_p]
+    lib.pa_sampler_drain.restype = ctypes.c_long
+    lib.pa_sampler_drain.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint8),
+                                     ctypes.c_long]
+    lib.pa_sampler_destroy.restype = None
+    lib.pa_sampler_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def decode_records(buf: bytes) -> list[tuple[int, int, np.ndarray, np.ndarray]]:
+    """Packed drain buffer -> [(pid, tid, kernel_frames, user_frames)]."""
+    out = []
+    pos = 0
+    n = len(buf)
+    while pos + 16 <= n:
+        pid, tid, nk, nu = struct.unpack_from("<IIII", buf, pos)
+        pos += 16
+        if nk + nu > MAX_STACK_DEPTH or pos + 8 * (nk + nu) > n:
+            break  # corrupt/truncated tail
+        frames = np.frombuffer(buf, np.uint64, nk + nu, pos)
+        pos += 8 * (nk + nu)
+        out.append((pid, tid, frames[:nk], frames[nk:]))
+    return out
+
+
+def records_to_snapshot(
+    records, mappings: MappingTable, period_ns: int, window_ns: int,
+) -> WindowSnapshot:
+    """Dedup identical (pid, tid, stack) records into counted rows
+    (the role the BPF stack_counts map plays in the reference)."""
+    n = len(records)
+    if n == 0:
+        return WindowSnapshot(
+            pids=np.zeros(0, np.int32), tids=np.zeros(0, np.int32),
+            counts=np.zeros(0, np.int64), user_len=np.zeros(0, np.int32),
+            kernel_len=np.zeros(0, np.int32),
+            stacks=np.zeros((0, STACK_SLOTS), np.uint64),
+            mappings=mappings, period_ns=period_ns, window_ns=window_ns,
+            time_ns=time.time_ns(),
+        )
+    pids = np.zeros(n, np.int32)
+    tids = np.zeros(n, np.int32)
+    ulen = np.zeros(n, np.int32)
+    klen = np.zeros(n, np.int32)
+    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+    for i, (pid, tid, kframes, uframes) in enumerate(records):
+        pids[i] = pid
+        tids[i] = tid
+        nu, nk = len(uframes), len(kframes)
+        ulen[i] = nu
+        klen[i] = nk
+        # formats.py contract: user frames first, then kernel tail.
+        stacks[i, :nu] = uframes
+        stacks[i, nu:nu + nk] = kframes
+
+    # Vectorized row dedup (same byte-view trick as CPUAggregator).
+    rec = np.zeros((n, STACK_SLOTS + 4), np.uint64)
+    rec[:, 0] = pids.astype(np.uint64)
+    rec[:, 1] = tids.astype(np.uint64)
+    rec[:, 2] = ulen.astype(np.uint64)
+    rec[:, 3] = klen.astype(np.uint64)
+    rec[:, 4:] = stacks
+    void = np.ascontiguousarray(rec).view(
+        np.dtype((np.void, rec.shape[1] * 8))).ravel()
+    _, first, inverse = np.unique(void, return_index=True, return_inverse=True)
+    counts = np.bincount(inverse, minlength=len(first)).astype(np.int64)
+    return WindowSnapshot(
+        pids=pids[first], tids=tids[first], counts=counts,
+        user_len=ulen[first], kernel_len=klen[first], stacks=stacks[first],
+        mappings=mappings, period_ns=period_ns, window_ns=window_ns,
+        time_ns=time.time_ns(),
+    )
+
+
+class PerfEventSampler:
+    """Capture source: poll() blocks one window then drains the rings."""
+
+    def __init__(self, frequency_hz: int = 100, window_s: float = 10.0,
+                 drain_cap_mb: int = 64):
+        self._lib = load_native()
+        self._freq = frequency_hz
+        self._window = window_s
+        self._cap = drain_cap_mb << 20
+        self._maps = ProcessMapCache()
+        self._objs = ObjectFileCache()
+        self._handle = self._lib.pa_sampler_create(frequency_hz)
+        if not self._handle:
+            err = ctypes.get_errno()
+            raise SamplerUnavailable(
+                f"perf_event_open failed (errno {err}): needs CAP_PERFMON or "
+                f"kernel.perf_event_paranoid <= 0"
+            )
+        if self._lib.pa_sampler_start(self._handle) != 0:
+            raise SamplerUnavailable("failed to enable perf events")
+        self.n_cpus = self._lib.pa_sampler_n_cpus(self._handle)
+
+    @property
+    def lost_samples(self) -> int:
+        return int(self._lib.pa_sampler_lost(self._handle))
+
+    def _drain(self) -> bytes:
+        buf = (ctypes.c_uint8 * self._cap)()
+        n = self._lib.pa_sampler_drain(
+            self._handle, buf, ctypes.c_long(self._cap))
+        if n < 0:
+            raise SamplerUnavailable("drain buffer overflow; raise drain_cap_mb")
+        return bytes(buf[:n])
+
+    def poll(self) -> WindowSnapshot:
+        deadline = time.monotonic() + self._window
+        # Drain mid-window too so a ring never wraps (the reference sizes
+        # BPF maps for a full window; perf rings are smaller).
+        chunks = []
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            time.sleep(min(1.0, remaining))
+            chunks.append(self._drain())
+        records = decode_records(b"".join(chunks))
+        per_pid = {}
+        for pid in sorted({r[0] for r in records}):
+            try:
+                per_pid[pid] = self._maps.executable_mappings(pid)
+            except OSError:
+                continue
+        table = build_mapping_table(per_pid, self._objs.build_ids(per_pid))
+        return records_to_snapshot(
+            records, table, int(1e9 / self._freq), int(self._window * 1e9),
+        )
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.pa_sampler_destroy(self._handle)
+            self._handle = None

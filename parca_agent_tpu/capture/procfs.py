@@ -72,6 +72,8 @@ class ProcfsSampler:
         self._maps = ProcessMapCache(fs=self._fs)
         self._prev: dict[int, int] = {}
         self._started = False
+        # (path, start, offset) -> runtime entry addr; constant per mapping.
+        self._entry_cache: dict[tuple, int | None] = {}
 
     def _pids(self) -> list[int]:
         try:
@@ -99,14 +101,21 @@ class ProcfsSampler:
         if not maps:
             return None
         m = maps[0]
+        key = (m.path, m.start, m.offset)
+        if key in self._entry_cache:
+            return self._entry_cache[key]
         try:
             ef = ElfFile(self._fs.read_bytes(host_path(pid, m.path)))
             base = compute_base(ef, ef.exec_load_segment(),
                                 m.start, m.end, m.offset)
-            return (ef.entry + base) % 2**64
+            addr = (ef.entry + base) % 2**64
         except (OSError, ElfError, BaseError):
             # Unreadable binary: attribute to the mapping start.
-            return m.start
+            addr = m.start
+        if len(self._entry_cache) > 4096:
+            self._entry_cache.clear()
+        self._entry_cache[key] = addr
+        return addr
 
     def collect(self, deltas: dict[int, int]) -> WindowSnapshot:
         """Tick deltas -> snapshot with real mappings + entry-point frames."""
@@ -159,7 +168,12 @@ class ProcfsSampler:
         cur = self.sample_ticks()
         for pid, t in cur.items():
             prev = self._prev.get(pid)
-            delta = t if prev is None and self._started else t - (prev or t)
+            if prev is None:
+                # PID first seen mid-run: a genuinely new process, count all
+                # its ticks. (prev == 0 is a real observation, not missing.)
+                delta = t if self._started else 0
+            else:
+                delta = t - prev
             if delta > 0:
                 window_deltas[pid] = window_deltas.get(pid, 0) + delta
         self._prev = cur

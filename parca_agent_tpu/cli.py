@@ -43,10 +43,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--local-store-directory", default="")
     p.add_argument("--aggregator", default="cpu", choices=["cpu", "tpu"],
                    help="window aggregation backend")
-    p.add_argument("--capture", default="procfs",
-                   choices=["procfs", "synthetic", "replay"],
-                   help="capture source (procfs sampler, synthetic load, "
-                        "or replay of saved snapshots)")
+    p.add_argument("--capture", default="perf",
+                   choices=["perf", "procfs", "synthetic", "replay"],
+                   help="capture source: perf (native perf_event sampler, "
+                        "real call stacks), procfs (unprivileged tick "
+                        "accounting), synthetic load, or replay of saved "
+                        "snapshots")
     p.add_argument("--replay", nargs="*", default=[],
                    help="snapshot files for --capture=replay")
     p.add_argument("--metadata-external-labels", default="",
@@ -127,13 +129,35 @@ def run(argv=None) -> int:
                 return generate(SyntheticSpec(seed=self._n))
 
         source = SyntheticSource()
-    else:
+    elif args.capture == "procfs":
         from parca_agent_tpu.capture.procfs import ProcfsSampler
 
         source = ProcfsSampler(
             frequency_hz=args.profiling_cpu_sampling_frequency,
             window_s=args.profiling_duration,
         )
+    else:
+        from parca_agent_tpu.capture.live import (
+            PerfEventSampler,
+            SamplerUnavailable,
+        )
+
+        try:
+            source = PerfEventSampler(
+                frequency_hz=args.profiling_cpu_sampling_frequency,
+                window_s=args.profiling_duration,
+            )
+        except SamplerUnavailable as e:
+            # Fall back the way the reference degrades when BPF features
+            # are unavailable: keep profiling with the weaker source.
+            print(f"perf capture unavailable ({e}); falling back to procfs",
+                  file=sys.stderr)
+            from parca_agent_tpu.capture.procfs import ProcfsSampler
+
+            source = ProcfsSampler(
+                frequency_hz=args.profiling_cpu_sampling_frequency,
+                window_s=args.profiling_duration,
+            )
 
     # -- aggregation backend -------------------------------------------------
     fallback = None
@@ -204,7 +228,14 @@ def run(argv=None) -> int:
     )
 
     # -- debuginfo -----------------------------------------------------------
-    debuginfo = None if args.debuginfo_upload_disable else DebuginfoManager()
+    # Upload only makes sense against a remote store; without one the
+    # manager would extract debuginfo nobody consumes.
+    debuginfo = None
+    if not args.debuginfo_upload_disable and args.remote_store_address:
+        from parca_agent_tpu.agent.debuginfo_client import GRPCDebuginfoClient
+
+        debuginfo = DebuginfoManager(
+            client=GRPCDebuginfoClient(store.channel))
 
     # -- profiler ------------------------------------------------------------
     windows_done = threading.Event()

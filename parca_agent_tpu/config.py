@@ -58,9 +58,10 @@ class ConfigReloader:
         if content is None or content == self._last_content:
             return False
         if self._last_content is not None:
-            # Debounce: require the content to be stable across the window
-            # (editors often write multiple times in quick succession).
-            self._stop.wait(min(self._debounce, self._poll))
+            # Debounce: require the content to be stable across the full
+            # debounce window (editors and configmap propagation often
+            # write multiple times in quick succession).
+            self._stop.wait(self._debounce)
             settled = self._read()
             if settled != content:
                 return False

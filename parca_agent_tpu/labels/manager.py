@@ -52,6 +52,7 @@ class LabelsManager:
         # (manager.go:46-58).
         self._label_cache = _TTLCache(3 * profiling_duration_s, clock)
         self._provider_cache = _TTLCache(60 * profiling_duration_s, clock)
+        self._calls = 0
 
     def apply_config(self, relabel_configs: list[RelabelConfig]) -> None:
         """Hot-reload seam (reference ApplyConfig, manager.go:119-133)."""
@@ -76,6 +77,12 @@ class LabelsManager:
 
     def label_set(self, name: str, pid: int) -> dict[str, str] | None:
         """Final label set for a profile, or None when relabeling drops it."""
+        # Expired entries for exited PIDs are never looked up again, so a
+        # periodic sweep keeps both caches bounded under PID churn.
+        self._calls += 1
+        if self._calls % 4096 == 0:
+            self._label_cache.purge()
+            self._provider_cache.purge()
         key = (name, pid)
         cached = self._label_cache.get(key)
         if cached is not None:

@@ -1,0 +1,242 @@
+// perf_event whole-machine stack sampler behind a C ABI.
+//
+// The native capture component of the framework (the role the eBPF C
+// program plays in the reference, bpf/cpu/cpu.bpf.c: per-CPU 100 Hz
+// sampling with kernel+user call chains). Where the reference's BPF
+// program aggregates in kernel maps, this sampler ships raw records and
+// the (much faster, batched) aggregation happens in the Aggregator --
+// capture stays dumb, aggregation stays pluggable.
+//
+// One perf_event_open(PERF_COUNT_SW_CPU_CLOCK, freq) per online CPU with
+// PERF_SAMPLE_TID | PERF_SAMPLE_CALLCHAIN (the perf-subsystem equivalent
+// of the reference's two unwind paths: the kernel walks both kernel and
+// frame-pointer user stacks for us). Each CPU gets a mmap'd ring; drain()
+// walks every ring and packs records into the caller's buffer:
+//
+//   record := u32 pid | u32 tid | u32 n_kernel | u32 n_user
+//             | u64 frames[n_kernel + n_user]            (kernel first)
+//
+// Python (capture/live.py) turns these into WindowSnapshot rows.
+//
+// Build: make -C parca_agent_tpu/native  (g++ -shared -fPIC)
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+#include <atomic>
+
+#include <fcntl.h>
+#include <linux/perf_event.h>
+#include <sys/ioctl.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMaxFrames = 127;  // reference depth cap (cpu.bpf.c:22-27)
+constexpr size_t kRingPages = 64;     // 256 KiB of ring per CPU + header page
+
+// PERF_CONTEXT_* sentinels that delimit kernel vs user frames in callchains.
+constexpr uint64_t kContextKernel = 0xffffffffffffff80ull;  // PERF_CONTEXT_KERNEL
+constexpr uint64_t kContextUser = 0xfffffffffffffe00ull;    // PERF_CONTEXT_USER
+constexpr uint64_t kContextMax = 0xfffffffffffff000ull;     // any marker >= this
+
+struct PerCpu {
+  int fd = -1;
+  void* ring = nullptr;
+  size_t ring_size = 0;
+  uint64_t tail = 0;  // our consumer position (data_tail mirror)
+};
+
+struct Sampler {
+  PerCpu* cpus = nullptr;
+  int n_cpus = 0;
+  int freq = 0;
+  std::atomic<bool> running{false};
+  uint64_t lost = 0;  // PERF_RECORD_LOST accounting
+};
+
+long perf_open(int cpu, int freq) {
+  perf_event_attr attr;
+  std::memset(&attr, 0, sizeof(attr));
+  attr.size = sizeof(attr);
+  attr.type = PERF_TYPE_SOFTWARE;
+  attr.config = PERF_COUNT_SW_CPU_CLOCK;
+  attr.sample_freq = static_cast<uint64_t>(freq);
+  attr.freq = 1;  // PerfBitFreq in the reference (cpu.go:236-243)
+  attr.sample_type = PERF_SAMPLE_TID | PERF_SAMPLE_CALLCHAIN;
+  attr.disabled = 1;
+  attr.inherit = 0;
+  attr.exclude_hv = 1;
+  attr.sample_max_stack = kMaxFrames;
+  // pid = -1, cpu = N: whole-machine, per-CPU (needs perf_event_paranoid
+  // <= 0 or CAP_PERFMON, like the reference needs CAP_BPF).
+  return syscall(SYS_perf_event_open, &attr, -1, cpu, -1, PERF_FLAG_FD_CLOEXEC);
+}
+
+void destroy_partial(Sampler* s, int opened) {
+  for (int j = 0; j < opened; j++) {
+    munmap(s->cpus[j].ring, s->cpus[j].ring_size);
+    close(s->cpus[j].fd);
+  }
+  delete[] s->cpus;
+  delete s;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns nullptr on failure; errno preserved from the first failing call.
+Sampler* pa_sampler_create(int freq_hz) {
+  long n = sysconf(_SC_NPROCESSORS_ONLN);
+  if (n <= 0) return nullptr;
+  Sampler* s = new Sampler();
+  s->n_cpus = static_cast<int>(n);
+  s->freq = freq_hz;
+  s->cpus = new PerCpu[n];
+  size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+  size_t ring_size = (kRingPages + 1) * page;
+  for (int i = 0; i < n; i++) {
+    long fd = perf_open(i, freq_hz);
+    if (fd < 0) {
+      int saved = errno;
+      destroy_partial(s, i);
+      errno = saved;
+      return nullptr;
+    }
+    void* ring = mmap(nullptr, ring_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                      static_cast<int>(fd), 0);
+    if (ring == MAP_FAILED) {
+      int saved = errno;
+      close(static_cast<int>(fd));
+      destroy_partial(s, i);
+      errno = saved;
+      return nullptr;
+    }
+    s->cpus[i].fd = static_cast<int>(fd);
+    s->cpus[i].ring = ring;
+    s->cpus[i].ring_size = ring_size;
+  }
+  return s;
+}
+
+int pa_sampler_n_cpus(Sampler* s) { return s ? s->n_cpus : 0; }
+uint64_t pa_sampler_lost(Sampler* s) { return s ? s->lost : 0; }
+
+int pa_sampler_start(Sampler* s) {
+  if (!s) return -1;
+  for (int i = 0; i < s->n_cpus; i++) {
+    if (ioctl(s->cpus[i].fd, PERF_EVENT_IOC_ENABLE, 0) != 0) return -1;
+  }
+  s->running.store(true);
+  return 0;
+}
+
+int pa_sampler_stop(Sampler* s) {
+  if (!s) return -1;
+  for (int i = 0; i < s->n_cpus; i++) {
+    ioctl(s->cpus[i].fd, PERF_EVENT_IOC_DISABLE, 0);
+  }
+  s->running.store(false);
+  return 0;
+}
+
+// Drain all rings into out (capacity cap bytes). Returns bytes written,
+// or -1 when a record would not fit (caller should grow the buffer).
+// Packing format documented at the top of this file.
+long pa_sampler_drain(Sampler* s, uint8_t* out, long cap) {
+  if (!s || !out) return -1;
+  long written = 0;
+  size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+  for (int i = 0; i < s->n_cpus; i++) {
+    PerCpu& pc = s->cpus[i];
+    auto* meta = static_cast<perf_event_mmap_page*>(pc.ring);
+    uint8_t* data = static_cast<uint8_t*>(pc.ring) + page;
+    uint64_t data_size = pc.ring_size - page;
+    uint64_t head = __atomic_load_n(&meta->data_head, __ATOMIC_ACQUIRE);
+    uint64_t tail = meta->data_tail;
+    while (tail < head) {
+      auto* hdr = reinterpret_cast<perf_event_header*>(
+          data + (tail % data_size));
+      // Records can wrap the ring; copy out when they do.
+      uint8_t stackbuf[8 * 1024];
+      uint8_t* rec = reinterpret_cast<uint8_t*>(hdr);
+      if ((tail % data_size) + hdr->size > data_size) {
+        uint64_t first = data_size - (tail % data_size);
+        if (hdr->size <= sizeof(stackbuf)) {
+          std::memcpy(stackbuf, rec, first);
+          std::memcpy(stackbuf + first, data, hdr->size - first);
+          rec = stackbuf;
+          hdr = reinterpret_cast<perf_event_header*>(rec);
+        } else {  // oversized wrapped record: skip
+          tail += hdr->size;
+          continue;
+        }
+      }
+      if (hdr->type == PERF_RECORD_LOST) {
+        // { header; u64 id; u64 lost; }
+        s->lost += *reinterpret_cast<uint64_t*>(rec + sizeof(*hdr) + 8);
+      } else if (hdr->type == PERF_RECORD_SAMPLE) {
+        // layout for our sample_type: u32 pid, tid; u64 nr; u64 ips[nr]
+        uint8_t* p = rec + sizeof(*hdr);
+        uint32_t pid, tid;
+        std::memcpy(&pid, p, 4);
+        std::memcpy(&tid, p + 4, 4);
+        p += 8;
+        uint64_t nr;
+        std::memcpy(&nr, p, 8);
+        p += 8;
+        if (nr <= kMaxFrames + 8) {  // frames + context markers
+          uint64_t kframes[kMaxFrames], uframes[kMaxFrames];
+          uint32_t nk = 0, nu = 0;
+          int mode = 0;  // 0 unknown, 1 kernel, 2 user
+          for (uint64_t f = 0; f < nr; f++) {
+            uint64_t ip;
+            std::memcpy(&ip, p + 8 * f, 8);
+            if (ip >= kContextMax) {
+              if (ip == kContextKernel) mode = 1;
+              else if (ip == kContextUser) mode = 2;
+              else mode = 0;
+              continue;
+            }
+            if (mode == 1 && nk < kMaxFrames) kframes[nk++] = ip;
+            else if (mode == 2 && nu < kMaxFrames) uframes[nu++] = ip;
+          }
+          if (nk + nu > 0 && nk + nu <= kMaxFrames) {
+            long need = 16 + 8l * (nk + nu);
+            if (written + need > cap) return -1;
+            uint8_t* o = out + written;
+            std::memcpy(o, &pid, 4);
+            std::memcpy(o + 4, &tid, 4);
+            std::memcpy(o + 8, &nk, 4);
+            std::memcpy(o + 12, &nu, 4);
+            std::memcpy(o + 16, kframes, 8l * nk);
+            std::memcpy(o + 16 + 8l * nk, uframes, 8l * nu);
+            written += need;
+          }
+        }
+      }
+      tail += hdr->size;
+    }
+    __atomic_store_n(&meta->data_tail, tail, __ATOMIC_RELEASE);
+    pc.tail = tail;
+  }
+  return written;
+}
+
+void pa_sampler_destroy(Sampler* s) {
+  if (!s) return;
+  pa_sampler_stop(s);
+  for (int i = 0; i < s->n_cpus; i++) {
+    if (s->cpus[i].ring) munmap(s->cpus[i].ring, s->cpus[i].ring_size);
+    if (s->cpus[i].fd >= 0) close(s->cpus[i].fd);
+  }
+  delete[] s->cpus;
+  delete s;
+}
+
+}  // extern "C"

@@ -118,7 +118,11 @@ class AgentHTTPServer:
                     self._send(503, b"no listener\n")
                     return
                 params = dict(urllib.parse.parse_qsl(url.query))
-                timeout = float(params.pop("timeout", "15"))
+                try:
+                    timeout = float(params.pop("timeout", "15"))
+                except ValueError:
+                    self._send(400, b"bad timeout parameter\n")
+                    return
                 want = params
 
                 def match(labels):

@@ -1,0 +1,108 @@
+"""Debuginfo gRPC client loopback: the full ShouldInitiate -> Initiate ->
+Upload(stream) -> MarkUploadFinished conversation against an in-process
+server."""
+
+import pytest
+
+from parca_agent_tpu.agent.debuginfo_client import (
+    INITIATE,
+    MARK_FINISHED,
+    SHOULD_INITIATE,
+    UPLOAD,
+    GRPCDebuginfoClient,
+    _dec_initiate_upload_id,
+    _dec_should_initiate,
+)
+from parca_agent_tpu.pprof.proto import iter_fields, put_tag_bytes, put_tag_varint
+
+
+def _fields(data):
+    return {f: v for f, _w, v in iter_fields(data)}
+
+
+def test_grpc_debuginfo_flow_loopback():
+    grpc = pytest.importorskip("grpc")
+    from concurrent import futures
+
+    state = {"uploads": {}, "have": set()}
+
+    def should_initiate(request, context):
+        f = _fields(request)
+        build_id = f[1].decode()
+        out = bytearray()
+        put_tag_varint(out, 1, 0 if build_id in state["have"] else 1)
+        return bytes(out)
+
+    def initiate(request, context):
+        f = _fields(request)
+        build_id = f[1].decode()
+        upload_id = f"up-{build_id[:6]}"
+        state["uploads"][upload_id] = {"build_id": build_id, "data": b"",
+                                       "size": f.get(2, 0)}
+        instr = bytearray()
+        put_tag_bytes(instr, 1, build_id.encode())
+        put_tag_bytes(instr, 2, upload_id.encode())
+        out = bytearray()
+        put_tag_bytes(out, 1, bytes(instr))
+        return bytes(out)
+
+    def upload(request_iterator, context):
+        upload_id = None
+        for req in request_iterator:
+            for field, wt, value in iter_fields(req):
+                if field == 1:  # info
+                    upload_id = _fields(value)[2].decode()
+                elif field == 2:  # chunk
+                    state["uploads"][upload_id]["data"] += value
+        return b""
+
+    def mark_finished(request, context):
+        f = _fields(request)
+        state["have"].add(f[1].decode())
+        return b""
+
+    def h_unary(fn):
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=lambda b: b,
+            response_serializer=lambda b: b)
+
+    svc_name = SHOULD_INITIATE.rsplit("/", 1)[0].lstrip("/")
+    handlers = grpc.method_handlers_generic_handler(svc_name, {
+        SHOULD_INITIATE.rsplit("/", 1)[1]: h_unary(should_initiate),
+        INITIATE.rsplit("/", 1)[1]: h_unary(initiate),
+        UPLOAD.rsplit("/", 1)[1]: grpc.stream_unary_rpc_method_handler(
+            upload, request_deserializer=lambda b: b,
+            response_serializer=lambda b: b),
+        MARK_FINISHED.rsplit("/", 1)[1]: h_unary(mark_finished),
+    })
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((handlers,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        client = GRPCDebuginfoClient(channel, timeout_s=10)
+        bid = "ab" * 20
+        payload = b"\x7fELF" + bytes(3_000_000)  # multi-chunk
+        assert client.exists(bid, "h1") is False
+        client.upload(bid, "h1", payload)
+        # Server now has it; exists flips.
+        assert client.exists(bid, "h1") is True
+        (up,) = state["uploads"].values()
+        assert up["build_id"] == bid
+        assert up["data"] == payload
+        assert up["size"] == len(payload)
+        channel.close()
+    finally:
+        server.stop(0)
+
+
+def test_codec_helpers():
+    out = bytearray()
+    put_tag_varint(out, 1, 1)
+    assert _dec_should_initiate(bytes(out)) is True
+    instr = bytearray()
+    put_tag_bytes(instr, 2, b"upload-7")
+    resp = bytearray()
+    put_tag_bytes(resp, 1, bytes(instr))
+    assert _dec_initiate_upload_id(bytes(resp)) == "upload-7"
