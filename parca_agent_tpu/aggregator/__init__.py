@@ -2,13 +2,19 @@
 
 The reference folds drained stack counts into per-PID profiles one map entry
 at a time inside `obtainProfiles` (reference pkg/profiler/cpu/cpu.go:505-718).
-Here aggregation is a pluggable `Aggregator` with three implementations:
+Here aggregation is a pluggable `Aggregator` with four implementations:
 
   NaiveAggregator  dict-based spec oracle; the executable definition of the
                    semantics, used only in tests
   CPUAggregator    vectorized numpy path; the default backend
-  TPUAggregator    batched JAX/XLA path over all PIDs at once (radix hash +
-                   sort + segment reductions), the flagship backend
+  TPUAggregator    stateless batched JAX/XLA path over all PIDs at once
+                   (radix hash + sort + segment reductions)
+  DictAggregator   the flagship: stateful device-resident stack dictionary;
+                   a steady-state window is one batched lookup+count kernel
+                   (aggregator/dict.py)
+
+TPUAggregator and DictAggregator import jax lazily; CPU-only deployments
+never pay for it.
 """
 
 from parca_agent_tpu.aggregator.base import (  # noqa: F401

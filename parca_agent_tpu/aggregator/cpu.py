@@ -113,6 +113,27 @@ class NaiveAggregator:
         return profiles
 
 
+def window_counts_rebuild(snapshot: WindowSnapshot) -> np.ndarray:
+    """Full-rebuild stack dedup to counts — the CPU-side analog of
+    DictAggregator.window_counts (used as the benchmark baseline so both
+    sides are timed at the same counts-only boundary)."""
+    n = len(snapshot)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    rec = np.zeros((n, STACK_SLOTS + 3), np.uint64)
+    rec[:, 0] = snapshot.pids.astype(np.uint64)
+    rec[:, 1] = snapshot.user_len.astype(np.uint64)
+    rec[:, 2] = snapshot.kernel_len.astype(np.uint64)
+    rec[:, 3:] = snapshot.stacks
+    void = np.ascontiguousarray(rec).view(
+        np.dtype((np.void, rec.shape[1] * 8))
+    ).ravel()
+    _, inverse = np.unique(void, return_inverse=True)
+    counts = np.zeros(int(inverse.max()) + 1, np.int64)
+    np.add.at(counts, inverse, snapshot.counts)
+    return counts
+
+
 class CPUAggregator:
     """Vectorized numpy aggregation — the default production backend."""
 

@@ -1,0 +1,425 @@
+"""Incremental aggregation with a device-resident stack dictionary.
+
+The TPU-first production design, and the answer to the transfer-cost wall
+the batch kernel hits (SURVEY.md section 7 hard part #3): an always-on
+profiler sees an almost-stationary stack population, so re-shipping and
+re-deduplicating every stack every 10 s window — which is what the
+reference's obtainProfiles does (pkg/profiler/cpu/cpu.go:505-718), and
+what our batch kernel faithfully accelerates — wastes nearly all of its
+work. Instead the device keeps a persistent open-addressing hash table of
+every stack ever seen:
+
+  device state   h1/h2/h3 uint32 [C] (96-bit identity), occupied bool [C],
+                 stack_id int32 [C] (dense insertion order)
+  per window     one jit call: batched linear-probe LOOKUP of all rows,
+                 scatter-add counts by stack_id -> counts[C]; fetch is one
+                 int32 [id_cap] buffer, independent of stack width.
+
+Misses (stacks not yet in the table) come back in a fixed-width miss
+buffer; the HOST owns insertion: it keeps an exact mirror (the same probe
+sequence on the same arrays), assigns dense ids, resolves the new stacks'
+locations/mappings once (numpy, incremental), and scatters the few new
+entries into the device table. First window pays full insertion; steady
+state inserts ~nothing.
+
+Identity is the 96-bit triple (h1,h2,h3) of the full padded row: collision
+probability over 1M stacks is ~1e-17 (the reference accepts 32-bit
+MurmurHash identity for its DWARF stacks, cpu.bpf.c:438-448 — this is 64
+bits stronger). The profile outputs are therefore exact per-stack counts;
+the one contract deviation from the batch backends is that each PidProfile
+lists the pid's full location registry (every location seen so far), a
+superset of the window's — valid pprof, same samples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from parca_agent_tpu.aggregator.base import PidProfile, ProfileMapping
+from parca_agent_tpu.aggregator.cpu import _pid_mappings
+from parca_agent_tpu.capture.formats import (
+    KERNEL_ADDR_START,
+    STACK_SLOTS,
+    MappingTable,
+    WindowSnapshot,
+)
+from parca_agent_tpu.ops.hashing import row_hash_np
+
+# Linear-probe bound. The capacity guard keeps load factor <= 0.5, and at
+# the default table sizing (2x the id capacity) it stays <= 0.25, where
+# chains beyond 16 are rare enough that whole windows see none — which
+# matters because ANY overflow miss costs one extra device->host fetch of
+# the miss buffer. Chains that do exceed the bound are absorbed by the
+# host as overflow misses; exactness is unaffected either way.
+_PROBES = 16
+
+
+@functools.lru_cache(maxsize=4)
+def _lookup_program(cap: int, id_cap: int, n_pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    def lookup(table, packed):
+        # table:  uint32 [cap, 4] rows of h1 | h2 | h3 | id+1 (0 = empty) —
+        #         ONE row-gather per probe step instead of five.
+        # packed: uint32 [4, n_pad] rows of h1 | h2 | h3 | counts —
+        #         ONE host->device buffer per window (round-trip latency
+        #         dominates at these sizes, so operand count matters more
+        #         than bytes).
+        h1, h2, h3 = packed[0], packed[1], packed[2]
+        cnt = packed[3].astype(jnp.int32)
+        mask = jnp.uint32(cap - 1)
+
+        def probe(k, state):
+            found_id, done = state
+            idx = ((h1 + jnp.uint32(k)) & mask).astype(jnp.int32)
+            row = table[idx]  # [n, 4]
+            occ = row[:, 3] > 0
+            hit = occ & (row[:, 0] == h1) & (row[:, 1] == h2) \
+                & (row[:, 2] == h3)
+            # An empty slot ends this key's probe chain: definitive miss.
+            stop = hit | ~occ
+            found_id = jnp.where(hit & ~done,
+                                 row[:, 3].astype(jnp.int32) - 1, found_id)
+            return found_id, done | stop
+
+        found_id = jnp.full(h1.shape, -1, jnp.int32)
+        done = jnp.zeros(h1.shape, bool)
+        found_id, _ = jax.lax.fori_loop(0, _PROBES, probe, (found_id, done))
+
+        live = cnt > 0
+        hit = (found_id >= 0) & live
+        counts = jnp.zeros((id_cap,), jnp.int32).at[
+            jnp.where(hit, found_id, id_cap)
+        ].add(cnt, mode="drop")
+        miss = live & ~hit
+        # Compact miss row indices into a fixed [n_pad] buffer.
+        mtgt = jnp.where(miss, jnp.cumsum(miss.astype(jnp.int32)) - 1,
+                         jnp.int32(n_pad))
+        miss_rows = jnp.full((n_pad,), -1, jnp.int32).at[mtgt].set(
+            jnp.arange(h1.shape[0], dtype=jnp.int32), mode="drop")
+        n_miss = miss.astype(jnp.int32).sum()
+        # counts + n_miss ride ONE device->host buffer; miss_rows is only
+        # fetched when n_miss > 0 (never, in steady state).
+        out = jnp.concatenate([counts, n_miss[None]])
+        return out, miss_rows
+
+    return jax.jit(lookup, donate_argnums=())
+
+
+@dataclasses.dataclass
+class _PidRegistry:
+    """Per-pid incremental location registry (grows, never shrinks).
+
+    Mappings are append-only with registry-stable 1-based ids: when a
+    later window brings a changed mapping table (dlopen, remap), new
+    ranges get NEW ids; existing loc_mapping_id values stay valid against
+    this registry's list rather than dangling into the new window's table.
+    """
+
+    addr_to_loc: dict  # int addr -> 1-based loc id
+    loc_address: list
+    loc_normalized: list
+    loc_mapping_id: list
+    loc_is_kernel: list
+    mappings: list     # ProfileMapping with registry-stable ids
+    mapping_index: dict  # (start, end, offset) -> 1-based registry id
+
+
+class DictAggregator:
+    """Stateful exact aggregation; reuse one instance across windows."""
+
+    name = "dict"
+
+    def __init__(self, capacity: int = 1 << 21, id_cap: int | None = None):
+        if capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two")
+        self._cap = capacity
+        self._id_cap = id_cap or capacity // 2
+        # Host mirror (source of truth).
+        self._h1 = np.zeros(capacity, np.uint32)
+        self._h2 = np.zeros(capacity, np.uint32)
+        self._h3 = np.zeros(capacity, np.uint32)
+        self._occ = np.zeros(capacity, bool)
+        self._ids = np.full(capacity, -1, np.int32)
+        self._key_to_id: dict[tuple, int] = {}
+        self._next_id = 0
+        # Per-id metadata (parallel lists, appended at insertion).
+        self._id_pid: list[int] = []
+        self._id_depth: list[int] = []
+        self._id_locs: list[np.ndarray] = []  # 1-based per-pid loc ids
+        self._pids: dict[int, _PidRegistry] = {}
+        # Device twin (created lazily; None until first window).
+        self._dev = None
+        self.stats = {"windows": 0, "inserts": 0, "overflow_misses": 0}
+
+    # -- public -------------------------------------------------------------
+
+    def aggregate(self, snapshot: WindowSnapshot,
+                  hashes=None) -> list[PidProfile]:
+        counts = self.window_counts(snapshot, hashes)
+        return self._build_profiles(snapshot, counts)
+
+    def hash_rows(self, snapshot: WindowSnapshot):
+        """The capture-side identity triple. In production the capture
+        source computes/carries this (the reference's BPF maps are KEYED by
+        the stack hash — cpu.bpf.c:438-448 — so its hot loop never hashes
+        either); replay/synthetic paths call this explicitly."""
+        return row_hash_np(snapshot.stacks, snapshot.pids,
+                           snapshot.user_len, snapshot.kernel_len,
+                           n_hashes=3)
+
+    def window_counts(self, snapshot: WindowSnapshot,
+                      hashes=None) -> np.ndarray:
+        """The aggregation core: int64 counts indexed by stack id
+        (length == number of stacks known after this window)."""
+        import jax.numpy as jnp
+
+        n = len(snapshot)
+        if n == 0:
+            return np.zeros(self._next_id, np.int64)
+        if int(snapshot.counts.sum()) >= 2**31:
+            raise ValueError("window sample total exceeds int32")
+        h1, h2, h3 = hashes if hashes is not None else self.hash_rows(snapshot)
+        n_pad = 1 << max(4, (n - 1).bit_length())
+        packed = np.zeros((4, n_pad), np.uint32)
+        packed[0, :n], packed[1, :n], packed[2, :n] = h1, h2, h3
+        packed[3, :n] = snapshot.counts.astype(np.uint32)
+
+        self._ensure_device()
+        prog = _lookup_program(self._cap, self._id_cap, n_pad)
+        dev_out, miss_rows = prog(self._dev, jnp.asarray(packed))
+        host_out = np.asarray(dev_out)
+        n_miss = int(host_out[-1])
+        out = host_out[:-1].astype(np.int64)
+
+        if n_miss:
+            rows = np.asarray(miss_rows)[:n_miss]
+            out = self._handle_misses(snapshot, rows, h1, h2, h3, out)
+        self.stats["windows"] += 1
+        return out[: self._next_id]
+
+    # -- internals ----------------------------------------------------------
+
+    def _ensure_device(self) -> None:
+        import jax.numpy as jnp
+
+        if self._dev is None:
+            table = np.zeros((self._cap, 4), np.uint32)
+            table[:, 0] = self._h1
+            table[:, 1] = self._h2
+            table[:, 2] = self._h3
+            table[:, 3] = np.where(self._occ, self._ids + 1, 0).astype(np.uint32)
+            self._dev = jnp.asarray(table)
+
+    def _handle_misses(self, snapshot, rows, h1, h2, h3,
+                       out: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        # Classify first, mutate second: capacity is validated against the
+        # ACTUAL number of new keys before anything is inserted — raising
+        # mid-loop would leave keys in _key_to_id without per-id metadata
+        # or device-table entries, corrupting every later window. (Device
+        # misses that are merely probe-bound overflows of known keys cost
+        # nothing here.)
+        classified: list[tuple[int, tuple, int | None]] = []
+        n_new = 0
+        seen_batch: set = set()
+        for r in map(int, rows):
+            key = (int(h1[r]), int(h2[r]), int(h3[r]))
+            existing = self._key_to_id.get(key)
+            if existing is None and key not in seen_batch:
+                seen_batch.add(key)
+                n_new += 1
+            classified.append((r, key, existing))
+        worst = self._next_id + n_new
+        if worst > self._id_cap or worst * 2 > self._cap:
+            raise RuntimeError(
+                f"stack dictionary capacity exhausted "
+                f"({self._next_id} ids + {n_new} new stacks vs "
+                f"id_cap {self._id_cap}, table {self._cap}); "
+                f"construct with a larger capacity"
+            )
+
+        new_slots: list[int] = []
+        new_rows: list[int] = []
+        pending: list[tuple[int, int]] = []  # (sid, count) corrections
+        for r, key, existing in classified:
+            if existing is None:
+                existing = self._key_to_id.get(key)  # set earlier this loop?
+            if existing is not None:
+                # Probe-bound overflow on device; host resolves it.
+                self.stats["overflow_misses"] += 1
+                pending.append((existing, int(snapshot.counts[r])))
+                continue
+            slot = self._host_insert_slot(key)
+            sid = self._next_id
+            self._next_id += 1
+            self._key_to_id[key] = sid
+            self._occ[slot] = True
+            self._h1[slot], self._h2[slot], self._h3[slot] = key
+            self._ids[slot] = sid
+            new_slots.append(slot)
+            new_rows.append(r)
+            pending.append((sid, int(snapshot.counts[r])))
+            self.stats["inserts"] += 1
+
+        if pending:
+            # `out` is the device scatter buffer, always [id_cap]-long.
+            sids = np.array([p[0] for p in pending], np.int64)
+            cnts = np.array([p[1] for p in pending], np.int64)
+            np.add.at(out, sids, cnts)
+
+        if new_slots:
+            self._register_stacks_bulk(snapshot, np.array(new_rows, np.int64))
+            idx = jnp.asarray(np.array(new_slots, np.int32))
+            vals = np.zeros((len(new_slots), 4), np.uint32)
+            vals[:, 0] = self._h1[new_slots]
+            vals[:, 1] = self._h2[new_slots]
+            vals[:, 2] = self._h3[new_slots]
+            vals[:, 3] = (self._ids[new_slots] + 1).astype(np.uint32)
+            self._dev = self._dev.at[idx].set(jnp.asarray(vals))
+        return out
+
+    def _host_insert_slot(self, key: tuple) -> int:
+        # Capacity was validated batch-wide by _handle_misses.
+        mask = self._cap - 1
+        idx = key[0] & mask
+        # Unbounded on host (correctness); the device probe bound only
+        # causes overflow_misses, which the host path absorbs.
+        while self._occ[idx]:
+            idx = (idx + 1) & mask
+        return idx
+
+    def _register_stacks_bulk(self, snapshot, rows: np.ndarray) -> None:
+        """Vectorized per-pid location registration for a batch of newly
+        inserted stacks (the first window inserts everything — a python
+        per-frame loop would dwarf the device work it replaces)."""
+        pids = snapshot.pids[rows]
+        depths = (snapshot.user_len + snapshot.kernel_len)[rows]
+        table = snapshot.mappings
+        # Batch outputs indexed by position in `rows` — positions correspond
+        # 1:1 to the contiguous sids the caller just assigned, so the global
+        # per-id lists stay aligned with stack ids.
+        nb = len(rows)
+        batch_locs: list = [None] * nb
+
+        for pid in np.unique(pids):
+            sel = np.flatnonzero(pids == pid)
+            reg = self._pids.get(int(pid))
+            if reg is None:
+                mappings = _pid_mappings(table, int(pid))
+                reg = _PidRegistry(
+                    {}, [], [], [], [], mappings,
+                    {(m.start, m.end, m.offset): m.id for m in mappings},
+                )
+                self._pids[int(pid)] = reg
+
+            prows = rows[sel]
+            pdepths = depths[sel]
+            stacks = snapshot.stacks[prows]
+            live = np.arange(STACK_SLOTS)[None, :] < pdepths[:, None]
+            addrs = stacks[live]
+            uniq = np.unique(addrs)
+            # New addresses for this pid's registry.
+            known = np.array([int(a) in reg.addr_to_loc for a in uniq], bool)
+            fresh = uniq[~known] if len(uniq) else uniq
+            if len(fresh):
+                is_kernel = fresh >= np.uint64(KERNEL_ADDR_START)
+                mrows = table.rows_for_pid(int(pid))
+                norm = fresh.copy()
+                map_id = np.zeros(len(fresh), np.int32)
+                if len(mrows):
+                    starts = table.starts[mrows]
+                    ends = table.ends[mrows]
+                    offsets = table.offsets[mrows]
+                    j = np.searchsorted(starts, fresh, "right").astype(np.int64) - 1
+                    safe = np.clip(j, 0, len(mrows) - 1)
+                    hit = (j >= 0) & (fresh < ends[safe]) & ~is_kernel
+                    norm = np.where(hit, fresh - starts[safe] + offsets[safe],
+                                    fresh)
+                    # Window-table rows -> registry-stable mapping ids
+                    # (appending ranges this registry hasn't seen yet).
+                    row_to_reg = np.zeros(len(mrows), np.int32)
+                    for row in np.unique(safe[hit]) if hit.any() else []:
+                        r = int(row)
+                        mkey = (int(starts[r]), int(ends[r]), int(offsets[r]))
+                        rid = reg.mapping_index.get(mkey)
+                        if rid is None:
+                            obj = int(table.objs[mrows[r]])
+                            rid = len(reg.mappings) + 1
+                            reg.mappings.append(ProfileMapping(
+                                id=rid, start=mkey[0], end=mkey[1],
+                                offset=mkey[2],
+                                path=(table.obj_paths[obj]
+                                      if 0 <= obj < len(table.obj_paths)
+                                      else ""),
+                                build_id=(table.obj_buildids[obj]
+                                          if 0 <= obj < len(table.obj_buildids)
+                                          else ""),
+                            ))
+                            reg.mapping_index[mkey] = rid
+                        row_to_reg[r] = rid
+                    map_id = np.where(hit, row_to_reg[safe], 0)
+                base = len(reg.loc_address)
+                reg.loc_address.extend(fresh.tolist())
+                reg.loc_normalized.extend(norm.tolist())
+                reg.loc_mapping_id.extend(map_id.tolist())
+                reg.loc_is_kernel.extend(is_kernel.tolist())
+                for k, a in enumerate(fresh.tolist()):
+                    reg.addr_to_loc[a] = base + k + 1
+
+            # Translate every frame to its 1-based loc id in one pass.
+            lut = np.array([reg.addr_to_loc[int(a)] for a in uniq], np.int32)
+            frame_ids = lut[np.searchsorted(uniq, stacks[live])]
+            id_rows = np.zeros((len(sel), STACK_SLOTS), np.int32)
+            id_rows[live] = frame_ids
+            for k, pos in enumerate(sel):
+                batch_locs[pos] = id_rows[k, : int(pdepths[k])].copy()
+
+        self._id_pid.extend(int(p) for p in pids)
+        self._id_depth.extend(int(d) for d in depths)
+        self._id_locs.extend(batch_locs)
+
+    def _build_profiles(self, snapshot: WindowSnapshot,
+                        counts: np.ndarray) -> list[PidProfile]:
+        ids = np.flatnonzero(counts)
+        if not len(ids):
+            return []
+        vals = counts[ids]
+        id_pid = np.array(self._id_pid, np.int64)[ids]
+        order = np.argsort(id_pid, kind="stable")
+        ids, vals, id_pid = ids[order], vals[order], id_pid[order]
+        bounds = np.flatnonzero(np.diff(id_pid)) + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [len(ids)]))
+
+        profiles = []
+        for lo, hi in zip(starts, ends):
+            pid = int(id_pid[lo])
+            reg = self._pids[pid]
+            sel = ids[lo:hi]
+            s = len(sel)
+            depths = np.array([self._id_depth[i] for i in sel], np.int32)
+            loc_rows = np.zeros((s, STACK_SLOTS), np.int32)
+            for k, i in enumerate(sel):
+                row = self._id_locs[i]
+                loc_rows[k, : len(row)] = row
+            profiles.append(PidProfile(
+                pid=pid,
+                stack_loc_ids=loc_rows,
+                stack_depths=depths,
+                values=vals[lo:hi].copy(),
+                loc_address=np.array(reg.loc_address, np.uint64),
+                loc_normalized=np.array(reg.loc_normalized, np.uint64),
+                loc_mapping_id=np.array(reg.loc_mapping_id, np.int32),
+                loc_is_kernel=np.array(reg.loc_is_kernel, bool),
+                mappings=reg.mappings,
+                period_ns=snapshot.period_ns,
+                time_ns=snapshot.time_ns,
+                duration_ns=snapshot.window_ns,
+            ))
+        return profiles

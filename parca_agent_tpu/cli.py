@@ -41,8 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remote-store-batch-write-interval", type=float,
                    default=10.0)
     p.add_argument("--local-store-directory", default="")
-    p.add_argument("--aggregator", default="cpu", choices=["cpu", "tpu"],
-                   help="window aggregation backend")
+    p.add_argument("--aggregator", default="cpu",
+                   choices=["cpu", "tpu", "dict"],
+                   help="window aggregation backend (dict = stateful "
+                        "device-resident stack dictionary, the TPU "
+                        "production mode)")
     p.add_argument("--capture", default="perf",
                    choices=["perf", "procfs", "synthetic", "replay"],
                    help="capture source: perf (native perf_event sampler, "
@@ -165,6 +168,11 @@ def run(argv=None) -> int:
         from parca_agent_tpu.aggregator.tpu import TPUAggregator
 
         aggregator = TPUAggregator()
+        fallback = CPUAggregator()
+    elif args.aggregator == "dict":
+        from parca_agent_tpu.aggregator.dict import DictAggregator
+
+        aggregator = DictAggregator()
         fallback = CPUAggregator()
     else:
         aggregator = CPUAggregator()

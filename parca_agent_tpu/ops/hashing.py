@@ -19,19 +19,32 @@ from __future__ import annotations
 
 import numpy as np
 
-# Fixed seed: hashes must be stable across processes so fleet-merged sketches
-# built on different hosts agree bucket-for-bucket.
-_COEF_RNG = np.random.default_rng(0x9E3779B9)
 # Enough coefficient lanes for [hi | lo | pid | user_len | kernel_len].
 _MAX_LANES = 2 * 128 + 8
+# Independent hash families: 2 for the batch kernel's sort keys, 3 for the
+# dictionary aggregator's 96-bit identity (its bucket index is family 0),
+# one spare. Each family draws from its OWN seeded stream so adding
+# families can never shift another family's constants — hashes must be
+# stable across processes and versions, or fleet-merged sketches built on
+# different hosts stop agreeing bucket-for-bucket.
+N_FAMILIES = 4
+
+
+def _family_rng(k: int) -> np.random.Generator:
+    return np.random.default_rng([0x9E3779B9, k])
+
+
 # Odd coefficients make x -> a*x a bijection mod 2^32.
-_COEFS = (
-    _COEF_RNG.integers(0, 1 << 32, size=(2, _MAX_LANES), dtype=np.uint64).astype(
-        np.uint32
-    )
-    | np.uint32(1)
-)
-_BIASES = _COEF_RNG.integers(0, 1 << 32, size=2, dtype=np.uint64).astype(np.uint32)
+_COEFS = np.stack([
+    _family_rng(k).integers(0, 1 << 32, _MAX_LANES, dtype=np.uint64)
+    .astype(np.uint32) | np.uint32(1)
+    for k in range(N_FAMILIES)
+])
+_BIASES = np.array([
+    int(np.random.default_rng([0x2545F491, k]).integers(
+        0, 1 << 32, dtype=np.uint64))
+    for k in range(N_FAMILIES)
+], np.uint32)
 
 
 def _np_or_jnp(x):
@@ -81,9 +94,10 @@ def fold_u64_rows(hi, lo, extra=None):
     return xp.concatenate(cols, axis=-1)
 
 
-def row_hash_np(stacks_u64: np.ndarray, pids, user_len, kernel_len):
-    """Host-side (numpy) twin of the device row hash; used by sketches and
-    tests to confirm host/device hash agreement."""
+def row_hash_np(stacks_u64: np.ndarray, pids, user_len, kernel_len,
+                n_hashes: int = 2):
+    """Host-side (numpy) twin of the device row hash; used by sketches, the
+    dictionary aggregator, and tests to confirm host/device agreement."""
     hi = (stacks_u64 >> np.uint64(32)).astype(np.uint32)
     lo = stacks_u64.astype(np.uint32)
     lanes = fold_u64_rows(
@@ -95,7 +109,4 @@ def row_hash_np(stacks_u64: np.ndarray, pids, user_len, kernel_len):
             np.asarray(kernel_len, np.uint32),
         ],
     )
-    return (
-        multilinear_hash_u32(lanes, 0),
-        multilinear_hash_u32(lanes, 1),
-    )
+    return tuple(multilinear_hash_u32(lanes, k) for k in range(n_hashes))

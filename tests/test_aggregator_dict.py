@@ -1,0 +1,155 @@
+"""Dictionary (incremental) aggregator tests: exactness vs the CPU oracle,
+steady-state behavior, overflow handling."""
+
+import numpy as np
+
+from parca_agent_tpu.aggregator.cpu import CPUAggregator
+from parca_agent_tpu.aggregator.dict import DictAggregator
+from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+
+def _samples_by_stack(profiles):
+    """(pid, loc-addr tuple) -> count, independent of loc-table layout."""
+    out = {}
+    for p in profiles:
+        addr = p.loc_address
+        for k in range(p.n_samples):
+            d = int(p.stack_depths[k])
+            key = (p.pid, tuple(int(addr[i - 1])
+                                for i in p.stack_loc_ids[k, :d]))
+            out[key] = out.get(key, 0) + int(p.values[k])
+    return out
+
+
+def test_dict_matches_cpu_oracle():
+    snap = generate(SyntheticSpec(n_pids=20, n_unique_stacks=300,
+                                  total_samples=5000, seed=3))
+    d = DictAggregator(capacity=1 << 12)
+    got = _samples_by_stack(d.aggregate(snap))
+    want = _samples_by_stack(CPUAggregator().aggregate(snap))
+    assert got == want
+
+
+def test_dict_steady_state_no_inserts():
+    snap = generate(SyntheticSpec(n_pids=10, n_unique_stacks=200,
+                                  total_samples=2000, seed=5))
+    d = DictAggregator(capacity=1 << 12)
+    d.aggregate(snap)
+    inserts_after_first = d.stats["inserts"]
+    assert inserts_after_first == len(snap)
+    # Same population again: pure lookups, zero inserts.
+    p2 = d.aggregate(snap)
+    assert d.stats["inserts"] == inserts_after_first
+    assert sum(p.total() for p in p2) == snap.total_samples()
+
+
+def test_dict_accumulates_new_stacks_across_windows():
+    a = generate(SyntheticSpec(n_pids=5, n_unique_stacks=50,
+                               total_samples=500, seed=1))
+    b = generate(SyntheticSpec(n_pids=5, n_unique_stacks=50,
+                               total_samples=500, seed=2))
+    d = DictAggregator(capacity=1 << 10)
+    pa = d.aggregate(a)
+    pb = d.aggregate(b)
+    assert sum(p.total() for p in pa) == a.total_samples()
+    assert sum(p.total() for p in pb) == b.total_samples()
+    # Window b got only b's counts even though the dict holds a's stacks.
+    want_b = _samples_by_stack(CPUAggregator().aggregate(b))
+    got_b = {k: v for k, v in _samples_by_stack(pb).items()}
+    assert got_b == want_b
+
+
+def test_dict_location_registry_is_superset():
+    snap = generate(SyntheticSpec(n_pids=4, n_unique_stacks=60,
+                                  total_samples=600, seed=7))
+    d = DictAggregator(capacity=1 << 10)
+    d.aggregate(snap)
+    profiles = d.aggregate(snap)
+    oracle = {p.pid: p for p in CPUAggregator().aggregate(snap)}
+    for p in profiles:
+        o = oracle[p.pid]
+        # Same addresses (registry == this window here), same normalization.
+        ours = dict(zip(p.loc_address.tolist(), p.loc_normalized.tolist()))
+        for a, n in zip(o.loc_address.tolist(), o.loc_normalized.tolist()):
+            assert ours[a] == n
+        p.check()
+
+
+def test_dict_probe_overflow_absorbed_by_host():
+    """With a tiny device probe bound relative to fill, overflow misses
+    must still aggregate exactly (host absorbs them)."""
+    snap = generate(SyntheticSpec(n_pids=8, n_unique_stacks=400,
+                                  total_samples=4000, seed=11))
+    # Capacity close to 2x entries: probe chains beyond _PROBES happen.
+    d = DictAggregator(capacity=1 << 10)
+    d.aggregate(snap)
+    got = _samples_by_stack(d.aggregate(snap))
+    want = _samples_by_stack(CPUAggregator().aggregate(snap))
+    assert got == want
+
+
+def test_dict_mapping_change_keeps_registry_ids_valid():
+    """A pid whose mapping table changes between windows (dlopen) must get
+    registry-stable mapping ids; profiles stay internally consistent."""
+    from parca_agent_tpu.capture.formats import (
+        STACK_SLOTS,
+        MappingTable,
+        WindowSnapshot,
+    )
+
+    def snap_with(table, addr):
+        stacks = np.zeros((1, STACK_SLOTS), np.uint64)
+        stacks[0, 0] = addr
+        return WindowSnapshot(
+            pids=np.array([9], np.int32), tids=np.array([9], np.int32),
+            counts=np.array([3], np.int64),
+            user_len=np.array([1], np.int32),
+            kernel_len=np.array([0], np.int32),
+            stacks=stacks, mappings=table,
+        )
+
+    t1 = MappingTable(
+        pids=np.array([9], np.int32),
+        starts=np.array([0x400000], np.uint64),
+        ends=np.array([0x500000], np.uint64),
+        offsets=np.array([0], np.uint64),
+        objs=np.array([0], np.int32),
+        obj_paths=("/bin/app",), obj_buildids=("aa",),
+    )
+    # Window 2: a library mapped BELOW the exe shifts the pid's row order.
+    t2 = MappingTable(
+        pids=np.array([9, 9], np.int32),
+        starts=np.array([0x200000, 0x400000], np.uint64),
+        ends=np.array([0x300000, 0x500000], np.uint64),
+        offsets=np.array([0, 0], np.uint64),
+        objs=np.array([1, 0], np.int32),
+        obj_paths=("/bin/app", "/lib/new.so"), obj_buildids=("aa", "bb"),
+    )
+    d = DictAggregator(capacity=1 << 8)
+    (p1,) = d.aggregate(snap_with(t1, 0x400123))
+    p1.check()
+    (p2,) = d.aggregate(snap_with(t2, 0x200077))  # new stack in new lib
+    p2.check()
+    by_addr = dict(zip(p2.loc_address.tolist(), p2.loc_mapping_id.tolist()))
+    # Old location keeps its original mapping id; the new lib was appended.
+    assert p2.mappings[by_addr[0x400123] - 1].path == "/bin/app"
+    assert p2.mappings[by_addr[0x200077] - 1].path == "/lib/new.so"
+    assert [m.id for m in p2.mappings] == list(range(1, len(p2.mappings) + 1))
+
+
+def test_dict_capacity_guard():
+    snap = generate(SyntheticSpec(n_pids=4, n_unique_stacks=100,
+                                  total_samples=1000, seed=2))
+    d = DictAggregator(capacity=64)
+    try:
+        d.aggregate(snap)
+        assert False, "expected capacity error"
+    except RuntimeError as e:
+        assert "capacity" in str(e) or "half full" in str(e)
+
+
+def test_dict_empty_window():
+    d = DictAggregator(capacity=1 << 8)
+    empty = generate(SyntheticSpec(n_pids=2, n_unique_stacks=4, n_rows=0,
+                                   total_samples=10, seed=1))
+    assert d.aggregate(empty) == []
