@@ -13,11 +13,12 @@ dedup addresses into per-profile locations, normalize user-space addresses to
 object-relative form, and attach the PID's mappings with 1-based pprof ids.
 Two deliberate deviations, both semantics-preserving:
 
-  - location/sample ordering is sorted (deterministic) rather than
-    first-seen, since pprof consumers treat these as sets;
-  - normalization here is mapping-based (addr - start + offset); the
-    ELF-aware base refinement (reference pkg/objectfile/object_file.go:
-    156-238) is applied by the symbolize layer when the object is readable.
+One deliberate deviation, semantics-preserving: location/sample ordering
+is sorted (deterministic) rather than first-seen, since pprof consumers
+treat these as sets. Normalization is `addr - base` with the ELF-derived
+base carried per mapping row (pprof GetBase semantics, reference
+pkg/objectfile/object_file.go:156-238); rows with no readable ELF fall
+back to base = start - offset (file-offset normalization).
 """
 
 from __future__ import annotations
@@ -40,6 +41,17 @@ class ProfileMapping:
     offset: int
     path: str = ""
     build_id: str = ""
+    # Normalization base (pprof GetBase semantics): object virtual address
+    # = runtime address - base. Defaults to start - offset (file-offset
+    # normalization) when no ELF-derived base is known; they differ by
+    # p_vaddr - p_offset of the exec segment (reference
+    # pkg/objectfile/object_file.go:156-238).
+    base: int | None = None
+
+    def __post_init__(self):
+        if self.base is None:
+            object.__setattr__(
+                self, "base", (self.start - self.offset) % 2**64)
 
 
 @dataclasses.dataclass

@@ -32,6 +32,7 @@ def _pid_mappings(table: MappingTable, pid: int) -> list[ProfileMapping]:
                 start=int(table.starts[r]),
                 end=int(table.ends[r]),
                 offset=int(table.offsets[r]),
+                base=int(table.bases[r]),
                 path=table.obj_paths[obj] if 0 <= obj < len(table.obj_paths) else "",
                 build_id=(
                     table.obj_buildids[obj]
@@ -78,7 +79,7 @@ class NaiveAggregator:
                     continue
                 for m in mappings:
                     if m.start <= a < m.end:
-                        loc_norm[j] = a - m.start + m.offset
+                        loc_norm[j] = (a - m.base) % 2**64
                         loc_map[j] = m.id
                         break
 
@@ -189,15 +190,13 @@ class CPUAggregator:
             rows = table.rows_for_pid(pid)
             starts = table.starts[rows]
             ends = table.ends[rows]
-            offsets = table.offsets[rows]
+            bases = table.bases[rows]
             if len(rows):
                 midx = np.searchsorted(starts, addrs, side="right").astype(np.int64) - 1
                 safe = np.clip(midx, 0, len(rows) - 1)
                 hit = (midx >= 0) & (addrs < ends[safe]) & ~is_kernel
                 loc_map = np.where(hit, (safe + 1).astype(np.int32), np.int32(0))
-                loc_norm = np.where(
-                    hit, addrs - starts[safe] + offsets[safe], addrs
-                )
+                loc_norm = np.where(hit, addrs - bases[safe], addrs)
             else:
                 loc_map = np.zeros(len(addrs), np.int32)
                 loc_norm = addrs.copy()

@@ -110,6 +110,112 @@ def _lookup_program(cap: int, id_cap: int, n_pad: int):
     return jax.jit(lookup, donate_argnums=())
 
 
+@functools.lru_cache(maxsize=8)
+def _feed_program(cap: int, id_cap: int, n_pad: int):
+    """Streaming-window accumulate: like _lookup_program but scatter-adds
+    into a persistent device accumulator instead of a fresh counts buffer.
+
+    The TPU-native answer to the reference's in-kernel accumulation (its
+    BPF stack_counts map absorbs samples DURING the window so window close
+    is cheap, bpf/cpu/cpu.bpf.c:110-116): capture drains feed the device
+    once a second, so the host<->device traffic rides the idle window and
+    close only has to pack + fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    def feed(table, acc, packed, reset):
+        # reset != 0: this is the first feed of a new window; the previous
+        # window's accumulator contents (kept across close for lossless
+        # retry) are discarded here, on device.
+        acc = jnp.where(reset != 0, 0, acc)
+        h1, h2, h3 = packed[0], packed[1], packed[2]
+        cnt = packed[3].astype(jnp.int32)
+        mask = jnp.uint32(cap - 1)
+
+        def probe(k, state):
+            found_id, done = state
+            idx = ((h1 + jnp.uint32(k)) & mask).astype(jnp.int32)
+            row = table[idx]
+            occ = row[:, 3] > 0
+            hit = occ & (row[:, 0] == h1) & (row[:, 1] == h2) \
+                & (row[:, 2] == h3)
+            stop = hit | ~occ
+            found_id = jnp.where(hit & ~done,
+                                 row[:, 3].astype(jnp.int32) - 1, found_id)
+            return found_id, done | stop
+
+        found_id = jnp.full(h1.shape, -1, jnp.int32)
+        done = jnp.zeros(h1.shape, bool)
+        found_id, _ = jax.lax.fori_loop(0, _PROBES, probe, (found_id, done))
+
+        live = cnt > 0
+        hit = (found_id >= 0) & live
+        acc = acc.at[jnp.where(hit, found_id, id_cap)].add(
+            cnt, mode="drop")
+        miss = live & ~hit
+        mtgt = jnp.where(miss, jnp.cumsum(miss.astype(jnp.int32)) - 1,
+                         jnp.int32(n_pad))
+        miss_rows = jnp.full((n_pad,), -1, jnp.int32).at[mtgt].set(
+            jnp.arange(h1.shape[0], dtype=jnp.int32), mode="drop")
+        n_miss = miss.astype(jnp.int32).sum()
+        return acc, n_miss, miss_rows
+
+    return jax.jit(feed, donate_argnums=(1,))
+
+
+# Overflow sideband sizes for the packed close fetch: ids whose window
+# count exceeds the packing sentinel. The accumulator is NOT cleared by
+# close (it resets on the next window's first feed), so a sideband overrun
+# is recoverable: the host just re-runs close at a wider packing. Width 16
+# is the lossless backstop — any window total < 2^31 yields at most
+# 2^31/65535 = 32768 overflows, exactly its sideband size.
+_CLOSE_OVERS = {4: 1 << 15, 8: 1 << 15, 16: 1 << 15}
+
+
+@functools.lru_cache(maxsize=12)
+def _close_program(id_cap: int, n_fetch: int, width: int):
+    """Window close: pack the accumulator's first n_fetch lanes to
+    uint{width} (width 4 packs two counts per byte) with an exact
+    (id, count) overflow sideband. The accumulator is left intact.
+
+    Output is ONE uint32 buffer (D2H round trips dominate at close):
+      [ n_fetch*width/32 lanes : packed counts, little-endian within u32
+      | n_over_buf             : overflow ids (u32; n_fetch = none)
+      | n_over_buf             : overflow counts
+      | 1                      : n_overflow (may exceed n_over_buf: retry)
+      | 1                      : count mass beyond n_fetch (guard; 0) ]
+    """
+    import jax
+    import jax.numpy as jnp
+
+    assert width in (4, 8, 16)
+    n_over_buf = _CLOSE_OVERS[width]
+    sentinel = (1 << width) - 1
+    per32 = 32 // width
+
+    def close(acc):
+        head = acc[:n_fetch]
+        over = head > (sentinel - 1)
+        vals = jnp.where(over, sentinel, head).astype(jnp.uint32)
+        shifts = (jnp.arange(per32, dtype=jnp.uint32) * width)[None, :]
+        lanes = (vals.reshape(-1, per32) << shifts).sum(
+            axis=1, dtype=jnp.uint32)
+        tgt = jnp.where(over, jnp.cumsum(over.astype(jnp.int32)) - 1,
+                        jnp.int32(n_over_buf))
+        ids = jnp.arange(n_fetch, dtype=jnp.uint32)
+        over_id = jnp.full((n_over_buf,), jnp.uint32(n_fetch)).at[tgt].set(
+            ids, mode="drop")
+        over_val = jnp.zeros((n_over_buf,), jnp.uint32).at[tgt].set(
+            head.astype(jnp.uint32), mode="drop")
+        n_over = over.astype(jnp.uint32).sum()
+        tail_total = acc[n_fetch:].sum().astype(jnp.uint32)
+        out = jnp.concatenate([
+            lanes, over_id, over_val, n_over[None], tail_total[None]])
+        return out
+
+    return jax.jit(close)
+
+
 @dataclasses.dataclass
 class _PidRegistry:
     """Per-pid incremental location registry (grows, never shrinks).
@@ -154,7 +260,14 @@ class DictAggregator:
         self._pids: dict[int, _PidRegistry] = {}
         # Device twin (created lazily; None until first window).
         self._dev = None
+        # Streaming-window state (feed/close_window protocol).
+        self._acc = None            # device int32 [id_cap] accumulator
+        self._fed_total = 0         # sample mass fed into the open window
+        self._needs_reset = False   # first feed of next window clears acc
+        self._prev_counts = None    # last closed window (width prediction)
+        self._pending: list[tuple[int, int]] = []  # host-side corrections
         self.stats = {"windows": 0, "inserts": 0, "overflow_misses": 0}
+        self.timings: dict[str, float] = {}
 
     # -- public -------------------------------------------------------------
 
@@ -202,6 +315,136 @@ class DictAggregator:
         self.stats["windows"] += 1
         return out[: self._next_id]
 
+    # -- streaming window protocol -------------------------------------------
+    #
+    # The production window shape (and the reason close is fast): capture
+    # drains arrive once a second, each drain is fed to the device as it
+    # lands (H2D + probe kernel ride the otherwise-idle window, exactly as
+    # the reference's BPF map absorbs samples in-kernel during the window,
+    # bpf/cpu/cpu.bpf.c:110-116), and window close only packs + fetches the
+    # accumulated counts. window_counts() remains the one-shot batch path.
+
+    def feed(self, snapshot: WindowSnapshot, hashes=None,
+             lo: int = 0, hi: int | None = None) -> None:
+        """Accumulate snapshot rows [lo, hi) into the open window."""
+        import time as _time
+
+        import jax.numpy as jnp
+
+        hi = len(snapshot) if hi is None else hi
+        n = hi - lo
+        if n <= 0:
+            return
+        chunk_total = int(snapshot.counts[lo:hi].sum())
+        if self._fed_total + chunk_total >= 2**31:
+            raise ValueError("window sample total exceeds int32")
+        h1, h2, h3 = hashes if hashes is not None else self.hash_rows(snapshot)
+        t0 = _time.perf_counter()
+        n_pad = 1 << max(4, (n - 1).bit_length())
+        packed = np.zeros((4, n_pad), np.uint32)
+        packed[0, :n] = h1[lo:hi]
+        packed[1, :n] = h2[lo:hi]
+        packed[2, :n] = h3[lo:hi]
+        packed[3, :n] = snapshot.counts[lo:hi].astype(np.uint32)
+        self.timings["feed_pack"] = _time.perf_counter() - t0
+
+        self._ensure_device()
+        if self._acc is None:
+            self._acc = jnp.zeros(self._id_cap, jnp.int32)
+        prog = _feed_program(self._cap, self._id_cap, n_pad)
+        t0 = _time.perf_counter()
+        acc = self._acc
+        self._acc = None  # donated: invalid if the call throws
+        reset = jnp.uint32(1 if self._needs_reset else 0)
+        acc, n_miss, miss_rows = prog(self._dev, acc, jnp.asarray(packed),
+                                      reset)
+        self._acc = acc
+        self._needs_reset = False
+        self._fed_total += chunk_total
+        nm = int(n_miss)  # device sync point
+        self.timings["feed_dispatch"] = _time.perf_counter() - t0
+        if nm:
+            t0 = _time.perf_counter()
+            rows = np.asarray(miss_rows)[:nm].astype(np.int64) + lo
+            self._pending.extend(
+                self._resolve_misses(snapshot, rows, h1, h2, h3))
+            self.timings["feed_miss"] = _time.perf_counter() - t0
+
+    def _pick_close_width(self) -> int:
+        """Packing width for this close: the narrowest that provably (from
+        the fed total) or predictably (from the last window's stationary
+        count distribution) keeps the overflow sideband within bounds. A
+        misprediction is detected and retried wider — never lossy."""
+        total = self._fed_total
+        if total // 15 <= _CLOSE_OVERS[4] // 2:
+            return 4
+        if self._prev_counts is not None and total // 255 <= _CLOSE_OVERS[8]:
+            if int((self._prev_counts > 14).sum()) <= _CLOSE_OVERS[4] // 2:
+                return 4
+        if total // 255 <= _CLOSE_OVERS[8]:
+            return 8
+        return 16
+
+    def close_window(self) -> np.ndarray:
+        """Finish the open window: fetch exact int64 counts indexed by
+        stack id (length == number of stacks known after this window).
+
+        The device accumulator is kept until the next window's first feed,
+        so a failed or mispredicted fetch can always be retried."""
+        import time as _time
+
+        if self._fed_total == 0 and not self._pending:
+            self.stats["windows"] += 1
+            return np.zeros(self._next_id, np.int64)
+
+        if self._acc is not None and self._fed_total:
+            grain = 1 << 18
+            n_fetch = min(self._id_cap,
+                          max(grain, -(-self._next_id // grain) * grain))
+            width = self._pick_close_width()
+            t0 = _time.perf_counter()
+            while True:
+                per32 = 32 // width
+                n_over_buf = _CLOSE_OVERS[width]
+                prog = _close_program(self._id_cap, n_fetch, width)
+                host = np.asarray(prog(self._acc))
+                n_over = int(host[-2])
+                if int(host[-1]) != 0:
+                    raise AssertionError("count mass beyond fetched prefix")
+                if n_over <= n_over_buf:
+                    break
+                # Sideband overran (width misprediction): acc is intact,
+                # go wider. Width 16 cannot overrun for int32 totals.
+                self.stats["close_retries"] = \
+                    self.stats.get("close_retries", 0) + 1
+                width = 8 if width == 4 else 16
+            self.timings["close_fetch"] = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            lanes_n = n_fetch // per32
+            lanes = host[:lanes_n]
+            sentinel = (1 << width) - 1
+            shifts = (np.arange(per32, dtype=np.uint32) * width)[None, :]
+            counts = ((lanes[:, None] >> shifts) & np.uint32(sentinel)) \
+                .reshape(-1).astype(np.int64)
+            over_id = host[lanes_n:lanes_n + n_over]
+            over_val = host[lanes_n + n_over_buf:lanes_n + n_over_buf + n_over]
+            counts[over_id] = over_val
+            self.timings["close_unpack"] = _time.perf_counter() - t0
+        else:
+            counts = np.zeros(max(self._next_id, 1), np.int64)
+
+        if self._pending:
+            sids = np.array([p[0] for p in self._pending], np.int64)
+            cnts = np.array([p[1] for p in self._pending], np.int64)
+            np.add.at(counts, sids, cnts)
+            self._pending = []
+        self._fed_total = 0
+        self._needs_reset = True
+        self.stats["windows"] += 1
+        out = counts[: self._next_id]
+        self._prev_counts = out
+        return out
+
     # -- internals ----------------------------------------------------------
 
     def _ensure_device(self) -> None:
@@ -217,6 +460,19 @@ class DictAggregator:
 
     def _handle_misses(self, snapshot, rows, h1, h2, h3,
                        out: np.ndarray) -> np.ndarray:
+        pending = self._resolve_misses(snapshot, rows, h1, h2, h3)
+        if pending:
+            # `out` is the device scatter buffer, always [id_cap]-long.
+            sids = np.array([p[0] for p in pending], np.int64)
+            cnts = np.array([p[1] for p in pending], np.int64)
+            np.add.at(out, sids, cnts)
+        return out
+
+    def _resolve_misses(self, snapshot, rows, h1, h2, h3
+                        ) -> list[tuple[int, int]]:
+        """Absorb device-miss rows: insert genuinely new stacks (host mirror
+        + device table), and return (stack_id, count) corrections the caller
+        must add to the window's counts."""
         import jax.numpy as jnp
 
         # Classify first, mutate second: capacity is validated against the
@@ -267,12 +523,6 @@ class DictAggregator:
             pending.append((sid, int(snapshot.counts[r])))
             self.stats["inserts"] += 1
 
-        if pending:
-            # `out` is the device scatter buffer, always [id_cap]-long.
-            sids = np.array([p[0] for p in pending], np.int64)
-            cnts = np.array([p[1] for p in pending], np.int64)
-            np.add.at(out, sids, cnts)
-
         if new_slots:
             self._register_stacks_bulk(snapshot, np.array(new_rows, np.int64))
             idx = jnp.asarray(np.array(new_slots, np.int32))
@@ -282,7 +532,7 @@ class DictAggregator:
             vals[:, 2] = self._h3[new_slots]
             vals[:, 3] = (self._ids[new_slots] + 1).astype(np.uint32)
             self._dev = self._dev.at[idx].set(jnp.asarray(vals))
-        return out
+        return pending
 
     def _host_insert_slot(self, key: tuple) -> int:
         # Capacity was validated batch-wide by _handle_misses.
@@ -336,11 +586,11 @@ class DictAggregator:
                     starts = table.starts[mrows]
                     ends = table.ends[mrows]
                     offsets = table.offsets[mrows]
+                    bases = table.bases[mrows]
                     j = np.searchsorted(starts, fresh, "right").astype(np.int64) - 1
                     safe = np.clip(j, 0, len(mrows) - 1)
                     hit = (j >= 0) & (fresh < ends[safe]) & ~is_kernel
-                    norm = np.where(hit, fresh - starts[safe] + offsets[safe],
-                                    fresh)
+                    norm = np.where(hit, fresh - bases[safe], fresh)
                     # Window-table rows -> registry-stable mapping ids
                     # (appending ranges this registry hasn't seen yet).
                     row_to_reg = np.zeros(len(mrows), np.int32)
@@ -360,6 +610,7 @@ class DictAggregator:
                                 build_id=(table.obj_buildids[obj]
                                           if 0 <= obj < len(table.obj_buildids)
                                           else ""),
+                                base=int(table.bases[mrows[r]]),
                             ))
                             reg.mapping_index[mkey] = rid
                         row_to_reg[r] = rid

@@ -380,9 +380,7 @@ class TPUAggregator:
         hit = (l_row >= 0) & ~l_kernel
         safe = np.maximum(l_row, 0)
         if len(table):
-            l_norm = np.where(
-                hit, l_addr - table.starts[safe] + table.offsets[safe], l_addr
-            )
+            l_norm = np.where(hit, l_addr - table.bases[safe], l_addr)
             # Global mapping row -> 1-based rank within its pid (rows are
             # sorted by (pid, start): rank = row - first row of pid's block).
             pid_first_row = np.searchsorted(table.pids, table.pids[safe], "left")

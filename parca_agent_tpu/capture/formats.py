@@ -31,6 +31,11 @@ needs, reference pkg/process/maps.go:73-128):
   map_ends    uint64 [M]   virtual end address (exclusive)
   map_offsets uint64 [M]   file offset of the mapping
   map_objs    int32  [M]   index into the object table (-1 = anonymous)
+  map_bases   uint64 [M]   normalization base: object vaddr = addr - base
+                           (pprof GetBase semantics, reference
+                           pkg/objectfile/object_file.go:156-238; defaults
+                           to start - offset when the ELF was unreadable,
+                           which matches file-offset normalization)
   obj_paths   list[str]    backing object path per object id
   obj_buildids list[str]   lowercase hex build id ('' if unknown)
 
@@ -57,7 +62,8 @@ STACK_SLOTS = 128
 KERNEL_ADDR_START = 0xFFFF_8000_0000_0000
 
 _MAGIC = b"PATPSNAP"
-_VERSION = 1
+# v2 added the mapping `bases` column; v1 files load with bases defaulted.
+_VERSION = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +77,7 @@ class MappingTable:
     objs: np.ndarray      # int32 [M]
     obj_paths: tuple[str, ...] = ()
     obj_buildids: tuple[str, ...] = ()
+    bases: np.ndarray | None = None  # uint64 [M]; None -> starts - offsets
 
     def __post_init__(self):
         object.__setattr__(self, "pids", np.asarray(self.pids, np.int32))
@@ -80,8 +87,12 @@ class MappingTable:
         object.__setattr__(self, "objs", np.asarray(self.objs, np.int32))
         object.__setattr__(self, "obj_paths", tuple(self.obj_paths))
         object.__setattr__(self, "obj_buildids", tuple(self.obj_buildids))
+        if self.bases is None:
+            object.__setattr__(self, "bases", self.starts - self.offsets)
+        else:
+            object.__setattr__(self, "bases", np.asarray(self.bases, np.uint64))
         m = len(self.pids)
-        for name in ("starts", "ends", "offsets", "objs"):
+        for name in ("starts", "ends", "offsets", "objs", "bases"):
             if len(getattr(self, name)) != m:
                 raise ValueError(f"mapping column {name!r} length mismatch")
         if len(self.obj_buildids) not in (0, len(self.obj_paths)):
@@ -219,7 +230,7 @@ def save_snapshot(snap: WindowSnapshot, path_or_file) -> None:
                 snap.kernel_len, snap.stacks):
         _write_arr(payload, arr)
     mt = snap.mappings
-    for arr in (mt.pids, mt.starts, mt.ends, mt.offsets, mt.objs):
+    for arr in (mt.pids, mt.starts, mt.ends, mt.offsets, mt.objs, mt.bases):
         _write_arr(payload, arr)
     _write_strs(payload, mt.obj_paths)
     _write_strs(payload, mt.obj_buildids)
@@ -242,7 +253,7 @@ def load_snapshot(path_or_file) -> WindowSnapshot:
     if raw[: len(_MAGIC)] != _MAGIC:
         raise ValueError("not a snapshot file (bad magic)")
     version = int.from_bytes(raw[len(_MAGIC): len(_MAGIC) + 4], "little")
-    if version != _VERSION:
+    if version not in (1, _VERSION):
         raise ValueError(f"unsupported snapshot version {version}")
     try:
         buf = io.BytesIO(zlib.decompress(raw[len(_MAGIC) + 4:]))
@@ -265,8 +276,9 @@ def load_snapshot(path_or_file) -> WindowSnapshot:
         _read_arr(buf, np.uint64, (m,)),
         _read_arr(buf, np.uint64, (m,)),
         _read_arr(buf, np.int32, (m,)),
-        _read_strs(buf),
-        _read_strs(buf),
+        bases=_read_arr(buf, np.uint64, (m,)) if version >= 2 else None,
+        obj_paths=_read_strs(buf),
+        obj_buildids=_read_strs(buf),
     )
     return WindowSnapshot(
         pids, tids, counts, user_len, kernel_len, stacks, mt,

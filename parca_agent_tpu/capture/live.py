@@ -8,17 +8,32 @@ deduplicated into (pid, stack) -> count rows (the aggregation the
 reference's BPF map does kernel-side happens here, vectorized), and joined
 with the live /proc mapping table.
 
-Record format (sampler.cc): u32 pid | u32 tid | u32 n_kernel | u32 n_user
-| u64 frames[n_kernel + n_user] (kernel-first; we store user-first in the
-snapshot per the formats.py contract).
+Two capture modes:
+
+  FP mode (default): kernel + frame-pointer user chains via
+  PERF_SAMPLE_CALLCHAIN (v1 record: u32 pid | u32 tid | u32 n_kernel |
+  u32 n_user | u64 frames[...], kernel-first).
+
+  DWARF mode (capture_stack=True): additionally snapshots user registers
+  and a stack slice per sample (v2 record, see sampler.cc header); at
+  drain time the batched walker (unwind/walker.py) unwinds frameless user
+  stacks against .eh_frame tables built by the watch-processes loop —
+  the role of the reference's debug_pids + in-kernel DWARF walker
+  (pkg/profiler/cpu/cpu.go:390-459, bpf/cpu/cpu.bpf.c:464-674).
+
+Drain overflow is lossless: the native side returns the records that fit
+and keeps the rest in the rings (truncation counter incremented); poll()
+immediately drains again.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
+import re
 import struct
 import subprocess
+import threading
 import time
 
 import numpy as np
@@ -35,13 +50,18 @@ from parca_agent_tpu.process.objectfile import ObjectFileCache
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _LIB = os.path.join(_NATIVE_DIR, "libpasampler.so")
 
+PA_CAPTURE_USER_STACK = 1
+
 
 class SamplerUnavailable(RuntimeError):
     pass
 
 
 def build_native(force: bool = False) -> str:
-    """Compile libpasampler.so if missing; returns its path."""
+    """Compile libpasampler.so if missing or stale; returns its path.
+
+    The shared object is never checked in (it is gitignored): a fresh
+    checkout always compiles from the reviewed source."""
     src = os.path.join(_NATIVE_DIR, "sampler.cc")
     if force or not os.path.exists(_LIB) or \
             os.path.getmtime(_LIB) < os.path.getmtime(src):
@@ -56,10 +76,15 @@ def load_native():
     lib = ctypes.CDLL(build_native(), use_errno=True)
     lib.pa_sampler_create.restype = ctypes.c_void_p
     lib.pa_sampler_create.argtypes = [ctypes.c_int]
+    lib.pa_sampler_create2.restype = ctypes.c_void_p
+    lib.pa_sampler_create2.argtypes = [ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_uint32]
     lib.pa_sampler_n_cpus.restype = ctypes.c_int
     lib.pa_sampler_n_cpus.argtypes = [ctypes.c_void_p]
     lib.pa_sampler_lost.restype = ctypes.c_uint64
     lib.pa_sampler_lost.argtypes = [ctypes.c_void_p]
+    lib.pa_sampler_truncated.restype = ctypes.c_uint64
+    lib.pa_sampler_truncated.argtypes = [ctypes.c_void_p]
     lib.pa_sampler_start.restype = ctypes.c_int
     lib.pa_sampler_start.argtypes = [ctypes.c_void_p]
     lib.pa_sampler_stop.restype = ctypes.c_int
@@ -74,7 +99,7 @@ def load_native():
 
 
 def decode_records(buf: bytes) -> list[tuple[int, int, np.ndarray, np.ndarray]]:
-    """Packed drain buffer -> [(pid, tid, kernel_frames, user_frames)]."""
+    """Packed v1 drain buffer -> [(pid, tid, kernel_frames, user_frames)]."""
     out = []
     pos = 0
     n = len(buf)
@@ -86,6 +111,30 @@ def decode_records(buf: bytes) -> list[tuple[int, int, np.ndarray, np.ndarray]]:
         frames = np.frombuffer(buf, np.uint64, nk + nu, pos)
         pos += 8 * (nk + nu)
         out.append((pid, tid, frames[:nk], frames[nk:]))
+    return out
+
+
+def decode_records_v2(buf: bytes) -> list[
+        tuple[int, int, np.ndarray, np.ndarray, int, int, int, np.ndarray]]:
+    """Packed v2 drain buffer ->
+    [(pid, tid, kframes, uframes, rip, rsp, rbp, stack_bytes)]."""
+    out = []
+    pos = 0
+    n = len(buf)
+    while pos + 48 <= n:
+        pid, tid, nk, nu = struct.unpack_from("<IIII", buf, pos)
+        rip, rsp, rbp, dyn, _pad = struct.unpack_from(
+            "<QQQII", buf, pos + 16)
+        pos += 48
+        dyn_pad = (dyn + 7) & ~7
+        if nk + nu > MAX_STACK_DEPTH or pos + 8 * (nk + nu) + dyn_pad > n:
+            break  # corrupt/truncated tail
+        frames = np.frombuffer(buf, np.uint64, nk + nu, pos)
+        pos += 8 * (nk + nu)
+        stack = np.frombuffer(buf, np.uint8, dyn, pos)
+        pos += dyn_pad
+        out.append((pid, tid, frames[:nk], frames[nk:], rip, rsp, rbp,
+                    stack))
     return out
 
 
@@ -138,18 +187,172 @@ def records_to_snapshot(
     )
 
 
+class UnwindTableCache:
+    """Per-pid merged compact unwind tables with background builds and 5 s
+    refresh (the role of the reference's watchProcesses loop,
+    pkg/profiler/cpu/cpu.go:390-459: match processes, build/refresh their
+    unwind tables off the hot path)."""
+
+    def __init__(self, map_cache: ProcessMapCache,
+                 comm_regex: str | None = None,
+                 refresh_s: float = 5.0, fs=None):
+        from parca_agent_tpu.unwind.table import UnwindTableBuilder
+        from parca_agent_tpu.utils.vfs import RealFS
+
+        self._fs = fs or RealFS()
+        self._builder = UnwindTableBuilder(fs=self._fs)
+        self._maps = map_cache
+        self._regex = re.compile(comm_regex) if comm_regex else None
+        self._refresh = refresh_s
+        self._tables: dict[int, np.ndarray] = {}
+        self._built_at: dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._queue: list[int] = []
+        self._qset: set[int] = set()
+        self._cv = threading.Condition(self._lock)
+        self._stop = False
+        self._worker: threading.Thread | None = None
+        self.stats = {"builds": 0, "build_errors": 0}
+
+    def _comm(self, pid: int) -> str:
+        try:
+            return self._fs.read_bytes(
+                f"/proc/{pid}/comm").decode().strip()
+        except OSError:
+            return ""
+
+    def matches(self, pid: int) -> bool:
+        if self._regex is None:
+            return True
+        return bool(self._regex.search(self._comm(pid)))
+
+    def table_for(self, pid: int) -> np.ndarray | None:
+        """The pid's table if built; queues a (re)build when missing or
+        stale. Never blocks the drain path."""
+        now = time.monotonic()
+        with self._lock:
+            t = self._tables.get(pid)
+            fresh = now - self._built_at.get(pid, 0) < self._refresh
+            if (t is None or not fresh) and pid not in self._qset:
+                self._qset.add(pid)
+                self._queue.append(pid)
+                self._cv.notify()
+                self._ensure_worker()
+            return t
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, name="unwind-table-builder", daemon=True)
+            self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(timeout=1.0)
+                if self._stop:
+                    return
+                pid = self._queue.pop(0)
+            try:
+                maps = self._maps.executable_mappings(pid)
+                table = self._builder.table_for_pid(pid, maps)
+                with self._lock:
+                    self._tables[pid] = table
+                    self._built_at[pid] = time.monotonic()
+                self.stats["builds"] += 1
+            except OSError:
+                with self._lock:
+                    self._built_at[pid] = time.monotonic()
+                self.stats["build_errors"] += 1
+            finally:
+                with self._lock:
+                    self._qset.discard(pid)
+
+    def build_now(self, pid: int) -> np.ndarray | None:
+        """Synchronous build (tests / tools)."""
+        try:
+            maps = self._maps.executable_mappings(pid)
+        except OSError:
+            return None
+        table = self._builder.table_for_pid(pid, maps)
+        with self._lock:
+            self._tables[pid] = table
+            self._built_at[pid] = time.monotonic()
+        self.stats["builds"] += 1
+        return table
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+
+def unwind_records(records_v2, tables: UnwindTableCache,
+                   min_fp_frames: int = 2, stats=None):
+    """v2 records -> v1-shaped records with DWARF-walked user stacks.
+
+    Per pid: samples whose frame-pointer chain already looks healthy
+    (>= min_fp_frames user frames) keep it; the rest are batch-unwound
+    against the pid's table when one exists (FP chain kept as fallback).
+    """
+    from parca_agent_tpu.unwind.walker import WalkStats, walk_batch
+
+    by_pid: dict[int, list[int]] = {}
+    for i, r in enumerate(records_v2):
+        by_pid.setdefault(r[0], []).append(i)
+
+    out = [(r[0], r[1], r[2], r[3]) for r in records_v2]
+    total_stats = stats if stats is not None else WalkStats()
+    for pid, idxs in by_pid.items():
+        need = [i for i in idxs
+                if len(records_v2[i][3]) < min_fp_frames
+                and records_v2[i][4] != 0]
+        if not need or not tables.matches(pid):
+            continue
+        table = tables.table_for(pid)
+        if table is None or len(table) == 0:
+            continue
+        m = len(need)
+        dmax = max(len(records_v2[i][7]) for i in need)
+        rip = np.zeros(m, np.uint64)
+        rsp = np.zeros(m, np.uint64)
+        rbp = np.zeros(m, np.uint64)
+        dyn = np.zeros(m, np.int64)
+        stacks = np.zeros((m, max(dmax, 8)), np.uint8)
+        for k, i in enumerate(need):
+            _, _, _, _, ip, sp, bp, stk = records_v2[i]
+            rip[k], rsp[k], rbp[k] = ip, sp, bp
+            dyn[k] = len(stk)
+            stacks[k, : len(stk)] = stk
+        frames, depth, st = walk_batch(table, rip, rsp, rbp, stacks, dyn)
+        total_stats.add(st)
+        for k, i in enumerate(need):
+            d = int(depth[k])
+            # Only adopt the walk when it beats the FP chain.
+            if d > len(records_v2[i][3]):
+                pid_, tid_, kf, _uf = out[i]
+                out[i] = (pid_, tid_, kf, frames[k, :d].copy())
+    return out
+
+
 class PerfEventSampler:
     """Capture source: poll() blocks one window then drains the rings."""
 
     def __init__(self, frequency_hz: int = 100, window_s: float = 10.0,
-                 drain_cap_mb: int = 64):
+                 drain_cap_mb: int = 64, capture_stack: bool = False,
+                 stack_dump_bytes: int = 16 * 1024,
+                 dwarf_comm_regex: str | None = None):
         self._lib = load_native()
         self._freq = frequency_hz
         self._window = window_s
         self._cap = drain_cap_mb << 20
         self._maps = ProcessMapCache()
         self._objs = ObjectFileCache()
-        self._handle = self._lib.pa_sampler_create(frequency_hz)
+        self.capture_stack = capture_stack
+        flags = PA_CAPTURE_USER_STACK if capture_stack else 0
+        self._handle = self._lib.pa_sampler_create2(
+            frequency_hz, flags, stack_dump_bytes)
         if not self._handle:
             err = ctypes.get_errno()
             raise SamplerUnavailable(
@@ -159,38 +362,69 @@ class PerfEventSampler:
         if self._lib.pa_sampler_start(self._handle) != 0:
             raise SamplerUnavailable("failed to enable perf events")
         self.n_cpus = self._lib.pa_sampler_n_cpus(self._handle)
+        self._tables = UnwindTableCache(
+            self._maps, comm_regex=dwarf_comm_regex) if capture_stack \
+            else None
+        from parca_agent_tpu.unwind.walker import WalkStats
+
+        self.walk_stats = WalkStats()
 
     @property
     def lost_samples(self) -> int:
         return int(self._lib.pa_sampler_lost(self._handle))
 
+    @property
+    def truncated_drains(self) -> int:
+        return int(self._lib.pa_sampler_truncated(self._handle))
+
     def _drain(self) -> bytes:
-        buf = (ctypes.c_uint8 * self._cap)()
-        n = self._lib.pa_sampler_drain(
-            self._handle, buf, ctypes.c_long(self._cap))
-        if n < 0:
-            raise SamplerUnavailable("drain buffer overflow; raise drain_cap_mb")
-        return bytes(buf[:n])
+        """Lossless drain: loops while the native side reports records left
+        behind for lack of buffer space."""
+        chunks = []
+        for _ in range(64):  # safety bound; one pass is the norm
+            before = self.truncated_drains
+            buf = (ctypes.c_uint8 * self._cap)()
+            n = self._lib.pa_sampler_drain(
+                self._handle, buf, ctypes.c_long(self._cap))
+            if n < 0:
+                raise SamplerUnavailable("sampler drain failed")
+            if n:
+                chunks.append(bytes(buf[:n]))
+            if self.truncated_drains == before:
+                break
+        return b"".join(chunks)
 
     def poll(self) -> WindowSnapshot:
         deadline = time.monotonic() + self._window
         # Drain mid-window too so a ring never wraps (the reference sizes
         # BPF maps for a full window; perf rings are smaller).
-        chunks = []
+        records = []
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             time.sleep(min(1.0, remaining))
-            chunks.append(self._drain())
-        records = decode_records(b"".join(chunks))
+            raw = self._drain()
+            if self.capture_stack:
+                v2 = decode_records_v2(raw)
+                # Queue table builds early so they're ready within the
+                # window (matches the 5 s watch cadence).
+                for pid in {r[0] for r in v2}:
+                    if self._tables.matches(pid):
+                        self._tables.table_for(pid)
+                records.extend(
+                    unwind_records(v2, self._tables,
+                                   stats=self.walk_stats))
+            else:
+                records.extend(decode_records(raw))
         per_pid = {}
         for pid in sorted({r[0] for r in records}):
             try:
                 per_pid[pid] = self._maps.executable_mappings(pid)
             except OSError:
                 continue
-        table = build_mapping_table(per_pid, self._objs.build_ids(per_pid))
+        table = build_mapping_table(per_pid, self._objs.build_ids(per_pid),
+                                    objcache=self._objs)
         return records_to_snapshot(
             records, table, int(1e9 / self._freq), int(self._window * 1e9),
         )
@@ -199,3 +433,5 @@ class PerfEventSampler:
         if self._handle:
             self._lib.pa_sampler_destroy(self._handle)
             self._handle = None
+        if self._tables is not None:
+            self._tables.close()

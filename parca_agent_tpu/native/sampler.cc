@@ -9,14 +9,34 @@
 //
 // One perf_event_open(PERF_COUNT_SW_CPU_CLOCK, freq) per online CPU with
 // PERF_SAMPLE_TID | PERF_SAMPLE_CALLCHAIN (the perf-subsystem equivalent
-// of the reference's two unwind paths: the kernel walks both kernel and
-// frame-pointer user stacks for us). Each CPU gets a mmap'd ring; drain()
-// walks every ring and packs records into the caller's buffer:
+// of the reference's kernel + frame-pointer unwind paths). In DWARF mode
+// (pa_sampler_create2 with PA_CAPTURE_USER_STACK) the kernel also
+// snapshots user registers and a slice of the user stack per sample
+// (PERF_SAMPLE_REGS_USER | PERF_SAMPLE_STACK_USER -- how `perf record
+// --call-graph dwarf` captures; the role of the reference's in-kernel
+// DWARF walker inputs, bpf/cpu/cpu.bpf.c:464-674), and the drain-time
+// batched unwinder (parca_agent_tpu/unwind/walker.py) applies the
+// .eh_frame tables to recover frameless user stacks.
 //
-//   record := u32 pid | u32 tid | u32 n_kernel | u32 n_user
-//             | u64 frames[n_kernel + n_user]            (kernel first)
+// Each CPU gets a mmap'd ring; drain() walks every ring and packs records
+// into the caller's buffer.
 //
-// Python (capture/live.py) turns these into WindowSnapshot rows.
+// v1 record (no user-stack capture):
+//   u32 pid | u32 tid | u32 n_kernel | u32 n_user
+//   | u64 frames[n_kernel + n_user]                      (kernel first)
+//
+// v2 record (PA_CAPTURE_USER_STACK mode):
+//   u32 pid | u32 tid | u32 n_kernel | u32 n_user
+//   | u64 rip | u64 rsp | u64 rbp                        (0 if unavailable)
+//   | u32 dyn_size | u32 _pad
+//   | u64 frames[n_kernel + n_user]
+//   | u8  stack[align8(dyn_size)]                        (memory at rsp)
+//
+// Drain contract: returns bytes written. A record that does not fit in the
+// caller's buffer is LEFT IN ITS RING (that ring's tail is committed only
+// up to the records already copied) and the truncation counter increments;
+// the caller drains again to fetch the remainder. Records are never
+// discarded once their ring tail has been committed.
 //
 // Build: make -C parca_agent_tpu/native  (g++ -shared -fPIC)
 
@@ -37,12 +57,19 @@
 namespace {
 
 constexpr uint64_t kMaxFrames = 127;  // reference depth cap (cpu.bpf.c:22-27)
-constexpr size_t kRingPages = 64;     // 256 KiB of ring per CPU + header page
+constexpr size_t kRingPagesFp = 64;      // 256 KiB of ring per CPU
+constexpr size_t kRingPagesStack = 512;  // 2 MiB per CPU when dumping stacks
 
 // PERF_CONTEXT_* sentinels that delimit kernel vs user frames in callchains.
 constexpr uint64_t kContextKernel = 0xffffffffffffff80ull;  // PERF_CONTEXT_KERNEL
 constexpr uint64_t kContextUser = 0xfffffffffffffe00ull;    // PERF_CONTEXT_USER
 constexpr uint64_t kContextMax = 0xfffffffffffff000ull;     // any marker >= this
+
+// x86_64 perf_regs indices (arch/x86/include/uapi/asm/perf_regs.h).
+constexpr int kRegBp = 6;
+constexpr int kRegSp = 7;
+constexpr int kRegIp = 8;
+constexpr uint64_t kRegsMask = (1ull << kRegBp) | (1ull << kRegSp) | (1ull << kRegIp);
 
 struct PerCpu {
   int fd = -1;
@@ -55,11 +82,16 @@ struct Sampler {
   PerCpu* cpus = nullptr;
   int n_cpus = 0;
   int freq = 0;
+  bool capture_stack = false;
+  uint32_t dump_bytes = 0;
   std::atomic<bool> running{false};
-  uint64_t lost = 0;  // PERF_RECORD_LOST accounting
+  uint64_t lost = 0;       // PERF_RECORD_LOST accounting
+  uint64_t truncated = 0;  // drain calls that ran out of caller buffer
+  uint8_t* scratch = nullptr;  // wrapped-record copy buffer
+  size_t scratch_size = 0;
 };
 
-long perf_open(int cpu, int freq) {
+long perf_open(int cpu, int freq, bool capture_stack, uint32_t dump_bytes) {
   perf_event_attr attr;
   std::memset(&attr, 0, sizeof(attr));
   attr.size = sizeof(attr);
@@ -68,6 +100,11 @@ long perf_open(int cpu, int freq) {
   attr.sample_freq = static_cast<uint64_t>(freq);
   attr.freq = 1;  // PerfBitFreq in the reference (cpu.go:236-243)
   attr.sample_type = PERF_SAMPLE_TID | PERF_SAMPLE_CALLCHAIN;
+  if (capture_stack) {
+    attr.sample_type |= PERF_SAMPLE_REGS_USER | PERF_SAMPLE_STACK_USER;
+    attr.sample_regs_user = kRegsMask;
+    attr.sample_stack_user = dump_bytes;
+  }
   attr.disabled = 1;
   attr.inherit = 0;
   attr.exclude_hv = 1;
@@ -83,6 +120,7 @@ void destroy_partial(Sampler* s, int opened) {
     close(s->cpus[j].fd);
   }
   delete[] s->cpus;
+  delete[] s->scratch;
   delete s;
 }
 
@@ -90,18 +128,36 @@ void destroy_partial(Sampler* s, int opened) {
 
 extern "C" {
 
+// flags for pa_sampler_create2
+enum { PA_CAPTURE_USER_STACK = 1 };
+
 // Returns nullptr on failure; errno preserved from the first failing call.
-Sampler* pa_sampler_create(int freq_hz) {
+// dump_bytes (user-stack slice per sample) must be a multiple of 8 and
+// < 64 KiB per the perf ABI; 0 picks the 16 KiB default.
+Sampler* pa_sampler_create2(int freq_hz, int flags, uint32_t dump_bytes) {
   long n = sysconf(_SC_NPROCESSORS_ONLN);
   if (n <= 0) return nullptr;
+  bool capture_stack = (flags & PA_CAPTURE_USER_STACK) != 0;
+  if (capture_stack) {
+    if (dump_bytes == 0) dump_bytes = 16 * 1024;
+    dump_bytes &= ~7u;
+    if (dump_bytes > 63 * 1024) dump_bytes = 63 * 1024;
+  } else {
+    dump_bytes = 0;
+  }
   Sampler* s = new Sampler();
   s->n_cpus = static_cast<int>(n);
   s->freq = freq_hz;
+  s->capture_stack = capture_stack;
+  s->dump_bytes = dump_bytes;
   s->cpus = new PerCpu[n];
+  s->scratch_size = 128 * 1024;
+  s->scratch = new uint8_t[s->scratch_size];
   size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
-  size_t ring_size = (kRingPages + 1) * page;
+  size_t data_pages = capture_stack ? kRingPagesStack : kRingPagesFp;
+  size_t ring_size = (data_pages + 1) * page;
   for (int i = 0; i < n; i++) {
-    long fd = perf_open(i, freq_hz);
+    long fd = perf_open(i, freq_hz, capture_stack, dump_bytes);
     if (fd < 0) {
       int saved = errno;
       destroy_partial(s, i);
@@ -124,8 +180,16 @@ Sampler* pa_sampler_create(int freq_hz) {
   return s;
 }
 
+Sampler* pa_sampler_create(int freq_hz) {
+  return pa_sampler_create2(freq_hz, 0, 0);
+}
+
 int pa_sampler_n_cpus(Sampler* s) { return s ? s->n_cpus : 0; }
 uint64_t pa_sampler_lost(Sampler* s) { return s ? s->lost : 0; }
+uint64_t pa_sampler_truncated(Sampler* s) { return s ? s->truncated : 0; }
+int pa_sampler_capture_stack(Sampler* s) {
+  return s && s->capture_stack ? 1 : 0;
+}
 
 int pa_sampler_start(Sampler* s) {
   if (!s) return -1;
@@ -145,14 +209,15 @@ int pa_sampler_stop(Sampler* s) {
   return 0;
 }
 
-// Drain all rings into out (capacity cap bytes). Returns bytes written,
-// or -1 when a record would not fit (caller should grow the buffer).
-// Packing format documented at the top of this file.
+// Drain all rings into out (capacity cap bytes). Returns bytes written;
+// see the drain contract at the top of this file. Returns -1 only on
+// invalid arguments.
 long pa_sampler_drain(Sampler* s, uint8_t* out, long cap) {
-  if (!s || !out) return -1;
+  if (!s || !out || cap < 0) return -1;
   long written = 0;
+  bool out_full = false;
   size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
-  for (int i = 0; i < s->n_cpus; i++) {
+  for (int i = 0; i < s->n_cpus && !out_full; i++) {
     PerCpu& pc = s->cpus[i];
     auto* meta = static_cast<perf_event_mmap_page*>(pc.ring);
     uint8_t* data = static_cast<uint8_t*>(pc.ring) + page;
@@ -163,14 +228,13 @@ long pa_sampler_drain(Sampler* s, uint8_t* out, long cap) {
       auto* hdr = reinterpret_cast<perf_event_header*>(
           data + (tail % data_size));
       // Records can wrap the ring; copy out when they do.
-      uint8_t stackbuf[8 * 1024];
       uint8_t* rec = reinterpret_cast<uint8_t*>(hdr);
       if ((tail % data_size) + hdr->size > data_size) {
         uint64_t first = data_size - (tail % data_size);
-        if (hdr->size <= sizeof(stackbuf)) {
-          std::memcpy(stackbuf, rec, first);
-          std::memcpy(stackbuf + first, data, hdr->size - first);
-          rec = stackbuf;
+        if (hdr->size <= s->scratch_size) {
+          std::memcpy(s->scratch, rec, first);
+          std::memcpy(s->scratch + first, data, hdr->size - first);
+          rec = s->scratch;
           hdr = reinterpret_cast<perf_event_header*>(rec);
         } else {  // oversized wrapped record: skip
           tail += hdr->size;
@@ -181,8 +245,12 @@ long pa_sampler_drain(Sampler* s, uint8_t* out, long cap) {
         // { header; u64 id; u64 lost; }
         s->lost += *reinterpret_cast<uint64_t*>(rec + sizeof(*hdr) + 8);
       } else if (hdr->type == PERF_RECORD_SAMPLE) {
-        // layout for our sample_type: u32 pid, tid; u64 nr; u64 ips[nr]
+        // layout for our sample_type (in ABI order):
+        //   u32 pid, tid; u64 nr; u64 ips[nr];
+        //   [u64 regs_abi; u64 regs[3] if abi != NONE]
+        //   [u64 stack_size; u8 stack[stack_size]; u64 dyn_size if size]
         uint8_t* p = rec + sizeof(*hdr);
+        uint8_t* rec_end = rec + hdr->size;
         uint32_t pid, tid;
         std::memcpy(&pid, p, 4);
         std::memcpy(&tid, p + 4, 4);
@@ -190,7 +258,7 @@ long pa_sampler_drain(Sampler* s, uint8_t* out, long cap) {
         uint64_t nr;
         std::memcpy(&nr, p, 8);
         p += 8;
-        if (nr <= kMaxFrames + 8) {  // frames + context markers
+        if (nr <= kMaxFrames + 8 && p + 8 * nr <= rec_end) {
           uint64_t kframes[kMaxFrames], uframes[kMaxFrames];
           uint32_t nk = 0, nu = 0;
           int mode = 0;  // 0 unknown, 1 kernel, 2 user
@@ -206,16 +274,86 @@ long pa_sampler_drain(Sampler* s, uint8_t* out, long cap) {
             if (mode == 1 && nk < kMaxFrames) kframes[nk++] = ip;
             else if (mode == 2 && nu < kMaxFrames) uframes[nu++] = ip;
           }
-          if (nk + nu > 0 && nk + nu <= kMaxFrames) {
+          p += 8 * nr;
+
+          uint64_t rip = 0, rsp = 0, rbp = 0;
+          uint8_t* stack = nullptr;
+          uint64_t dyn = 0;
+          bool parse_ok = true;
+          if (s->capture_stack) {
+            // REGS_USER: abi word, then one u64 per set mask bit in
+            // ascending bit order: BP(6), SP(7), IP(8).
+            if (p + 8 <= rec_end) {
+              uint64_t abi;
+              std::memcpy(&abi, p, 8);
+              p += 8;
+              if (abi != 0 /* PERF_SAMPLE_REGS_ABI_NONE */) {
+                if (p + 24 <= rec_end) {
+                  std::memcpy(&rbp, p, 8);
+                  std::memcpy(&rsp, p + 8, 8);
+                  std::memcpy(&rip, p + 16, 8);
+                  p += 24;
+                } else {
+                  parse_ok = false;
+                }
+              }
+            } else {
+              parse_ok = false;
+            }
+            // STACK_USER: size word, raw bytes, dyn_size word.
+            if (parse_ok && p + 8 <= rec_end) {
+              uint64_t size;
+              std::memcpy(&size, p, 8);
+              p += 8;
+              if (size) {
+                if (p + size + 8 <= rec_end) {
+                  stack = p;
+                  p += size;
+                  std::memcpy(&dyn, p, 8);
+                  p += 8;
+                  if (dyn > size) dyn = size;
+                } else {
+                  parse_ok = false;
+                }
+              }
+            }
+          }
+
+          if (parse_ok && nk + nu + (rip ? 1 : 0) > 0 &&
+              nk + nu <= kMaxFrames) {
+            uint64_t dyn_pad = (dyn + 7) & ~7ull;
             long need = 16 + 8l * (nk + nu);
-            if (written + need > cap) return -1;
+            if (s->capture_stack) need += 32 + static_cast<long>(dyn_pad);
+            if (written + need > cap) {
+              // Leave this record (and the rest of this ring) for the
+              // next drain; commit only what we already consumed.
+              s->truncated++;
+              out_full = true;
+              break;
+            }
             uint8_t* o = out + written;
             std::memcpy(o, &pid, 4);
             std::memcpy(o + 4, &tid, 4);
             std::memcpy(o + 8, &nk, 4);
             std::memcpy(o + 12, &nu, 4);
-            std::memcpy(o + 16, kframes, 8l * nk);
-            std::memcpy(o + 16 + 8l * nk, uframes, 8l * nu);
+            o += 16;
+            if (s->capture_stack) {
+              uint32_t dyn32 = static_cast<uint32_t>(dyn);
+              uint32_t zero = 0;
+              std::memcpy(o, &rip, 8);
+              std::memcpy(o + 8, &rsp, 8);
+              std::memcpy(o + 16, &rbp, 8);
+              std::memcpy(o + 24, &dyn32, 4);
+              std::memcpy(o + 28, &zero, 4);
+              o += 32;
+            }
+            std::memcpy(o, kframes, 8l * nk);
+            std::memcpy(o + 8l * nk, uframes, 8l * nu);
+            o += 8l * (nk + nu);
+            if (s->capture_stack && dyn_pad) {
+              std::memcpy(o, stack, dyn);
+              std::memset(o + dyn, 0, dyn_pad - dyn);
+            }
             written += need;
           }
         }
@@ -236,6 +374,7 @@ void pa_sampler_destroy(Sampler* s) {
     if (s->cpus[i].fd >= 0) close(s->cpus[i].fd);
   }
   delete[] s->cpus;
+  delete[] s->scratch;
   delete s;
 }
 

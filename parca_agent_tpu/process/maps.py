@@ -97,20 +97,36 @@ def host_path(pid: int, path: str) -> str:
 def build_mapping_table(
     per_pid: dict[int, list[ProcMapping]],
     build_ids: dict[str, str] | None = None,
+    objcache=None,
 ) -> MappingTable:
     """Fold executable file-backed mappings of many PIDs into one sorted
     MappingTable; objects dedup by path (as on a real host where every
     process maps the same libc — reference pkg/debuginfo/manager.go:116-127
-    relies on exactly this fan-in for upload dedup)."""
+    relies on exactly this fan-in for upload dedup).
+
+    With an ObjectFileCache, each row's normalization base is derived from
+    the mapped ELF's program headers (pprof GetBase semantics, reference
+    pkg/objectfile/object_file.go:156-238); unreadable objects fall back to
+    base = start - offset."""
     build_ids = build_ids or {}
     obj_ids: dict[str, int] = {}
-    rows: list[tuple[int, int, int, int, int]] = []
+    rows: list[tuple[int, int, int, int, int, int]] = []
     for pid, maps in per_pid.items():
         for m in maps:
             if not (m.executable and m.file_backed):
                 continue
             obj = obj_ids.setdefault(m.path, len(obj_ids))
-            rows.append((pid, m.start, m.end, m.offset, obj))
+            base = None
+            if objcache is not None:
+                of = objcache.get(pid, m)
+                if of is not None:
+                    try:
+                        base = of.base()
+                    except Exception:
+                        base = None
+            if base is None:
+                base = (m.start - m.offset) % 2**64
+            rows.append((pid, m.start, m.end, m.offset, obj, base))
     if not rows:
         return MappingTable.empty()
     rows.sort(key=lambda r: (r[0], r[1]))
@@ -124,4 +140,5 @@ def build_mapping_table(
         objs=arr[:, 4].astype(np.int32),
         obj_paths=tuple(paths),
         obj_buildids=tuple(build_ids.get(p, "") for p in paths),
+        bases=arr[:, 5],
     )

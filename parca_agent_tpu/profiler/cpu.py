@@ -93,7 +93,16 @@ class CPUProfiler:
 
     def run_iteration(self) -> bool:
         """Returns False when the source is exhausted."""
-        snapshot = self._source.poll()
+        try:
+            snapshot = self._source.poll()
+        except Exception as e:
+            # Capture trouble is non-fatal, like any other iteration error
+            # (cpu.go:326-330): a transient drain failure must not kill the
+            # agent. run() waits out the rest of the window, a natural
+            # backoff before the retry.
+            self.last_error = e
+            self.metrics.errors_total += 1
+            return True
         if snapshot is None:
             return False
         self.last_profile_started_at = time.time()
@@ -151,12 +160,21 @@ class CPUProfiler:
     # -- actor --------------------------------------------------------------
 
     def run(self) -> None:
-        while not self._stop.is_set():
-            t0 = time.monotonic()
-            if not self.run_iteration():
-                return
-            elapsed = time.monotonic() - t0
-            self._stop.wait(max(0.0, self._duration - elapsed))
+        try:
+            while not self._stop.is_set():
+                t0 = time.monotonic()
+                if not self.run_iteration():
+                    return
+                elapsed = time.monotonic() - t0
+                self._stop.wait(max(0.0, self._duration - elapsed))
+        except BaseException as e:
+            # Anything escaping run_iteration is a bug, not an iteration
+            # failure; record it so the CLI can exit nonzero instead of
+            # treating thread death as a clean shutdown.
+            self.crashed = e
+            raise
+
+    crashed: BaseException | None = None
 
     def stop(self) -> None:
         self._stop.set()

@@ -17,8 +17,13 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
-def render_status_page(profilers, version: str = "dev") -> str:
+def render_status_page(profilers, version: str = "dev",
+                       capture_info: dict | None = None) -> str:
     rows = []
+    if capture_info:
+        kv = ", ".join(f"{html.escape(str(k))}: {html.escape(str(v))}"
+                       for k, v in capture_info.items())
+        rows.append(f"<p>capture: {kv}</p>")
     for p in profilers:
         rows.append(
             f"<h2>{html.escape(p.name)}</h2>"
@@ -83,7 +88,8 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None) -> s
 class AgentHTTPServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 7071,
                  profilers=(), batch_client=None, listener=None,
-                 version: str = "dev", extra_metrics=None):
+                 version: str = "dev", extra_metrics=None,
+                 capture_info=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -100,8 +106,10 @@ class AgentHTTPServer:
             def do_GET(self):
                 url = urllib.parse.urlparse(self.path)
                 if url.path == "/":
+                    info = outer.capture_info() if outer.capture_info else None
                     self._send(200, render_status_page(
-                        outer.profilers, outer.version).encode(), "text/html")
+                        outer.profilers, outer.version, info).encode(),
+                        "text/html")
                 elif url.path == "/metrics":
                     extra = outer.extra_metrics() if outer.extra_metrics else {}
                     self._send(200, render_metrics(
@@ -146,6 +154,7 @@ class AgentHTTPServer:
         self.listener = listener
         self.version = version
         self.extra_metrics = extra_metrics
+        self.capture_info = capture_info
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None

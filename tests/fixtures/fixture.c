@@ -1,0 +1,45 @@
+/* Test fixture: a few distinct functions so the symtab has addresses in
+   several pages, plus PLT calls (via libc) for PLT-entry eh_frame rows.
+   With "spin <seconds>" it busy-loops in the leaf->middle->outer chain so
+   a live profiler can sample deep user stacks. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+__attribute__((noinline)) int leaf(int x) {
+  volatile int acc = x;
+  for (int i = 0; i < 2000; i++) acc = acc * 3 + 1;
+  return acc;
+}
+
+__attribute__((noinline)) int middle(int x) {
+  int acc = 0;
+  for (int i = 0; i < x; i++) acc += leaf(i);
+  return acc;
+}
+
+__attribute__((noinline)) int outer(int x) {
+  char buf[64];
+  snprintf(buf, sizeof buf, "%d", middle(x));
+  return atoi(buf);
+}
+
+int main(int argc, char **argv) {
+  if (argc >= 2 && strcmp(argv[1], "spin") == 0) {
+    double secs = argc >= 3 ? atof(argv[2]) : 2.0;
+    struct timespec t0, t;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    long iters = 0;
+    for (;;) {
+      iters += outer(50);
+      clock_gettime(CLOCK_MONOTONIC, &t);
+      if ((t.tv_sec - t0.tv_sec) + 1e-9 * (t.tv_nsec - t0.tv_nsec) > secs)
+        break;
+    }
+    printf("%ld\n", iters);
+    return 0;
+  }
+  printf("%d\n", outer(argc + 40));
+  return 0;
+}

@@ -148,6 +148,91 @@ def test_dict_capacity_guard():
         assert "capacity" in str(e) or "half full" in str(e)
 
 
+def test_dict_streaming_feed_close_matches_batch():
+    """feed() chunks + close_window() must equal the one-shot batch path,
+    including mid-stream inserts of never-seen stacks."""
+    snap = generate(SyntheticSpec(n_pids=12, n_unique_stacks=500,
+                                  total_samples=6000, seed=21))
+    batch = DictAggregator(capacity=1 << 12)
+    want = batch.window_counts(snap)
+
+    d = DictAggregator(capacity=1 << 12)
+    h = d.hash_rows(snap)
+    step = 97  # odd chunk size: exercises padding + chunk boundaries
+    for lo in range(0, len(snap), step):
+        d.feed(snap, h, lo, min(lo + step, len(snap)))
+    got = d.close_window()
+    assert np.array_equal(got, want)
+    assert int(got.sum()) == snap.total_samples()
+
+    # Steady state: same rows again through the stream, no inserts.
+    inserts = d.stats["inserts"]
+    for lo in range(0, len(snap), 173):
+        d.feed(snap, h, lo, min(lo + 173, len(snap)))
+    got2 = d.close_window()
+    assert np.array_equal(got2, want)
+    assert d.stats["inserts"] == inserts
+
+
+def test_dict_streaming_overflow_sideband():
+    """Counts above the uint8 pack sentinel must come back exact via the
+    overflow sideband."""
+    from parca_agent_tpu.capture.formats import (
+        STACK_SLOTS,
+        MappingTable,
+        WindowSnapshot,
+    )
+
+    table = MappingTable(
+        pids=np.zeros(0, np.int32), starts=np.zeros(0, np.uint64),
+        ends=np.zeros(0, np.uint64), offsets=np.zeros(0, np.uint64),
+        objs=np.zeros(0, np.int32), obj_paths=(), obj_buildids=(),
+    )
+    n = 8
+    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+    stacks[:, 0] = np.arange(1, n + 1, dtype=np.uint64) * 4096
+    counts = np.array([1, 254, 255, 256, 300, 70000, 2, 99999], np.int64)
+    snap = WindowSnapshot(
+        pids=np.full(n, 7, np.int32), tids=np.full(n, 7, np.int32),
+        counts=counts, user_len=np.ones(n, np.int32),
+        kernel_len=np.zeros(n, np.int32), stacks=stacks, mappings=table,
+    )
+    d = DictAggregator(capacity=1 << 8)
+    d.window_counts(snap)  # stage population
+    d.feed(snap)
+    got = d.close_window()
+    assert got.tolist() == counts.tolist()
+
+
+def test_dict_streaming_width_misprediction_retries_lossless():
+    """A window whose count distribution shifts hard (many ids crossing the
+    4-bit sentinel) must overrun the narrow sideband, retry wider against
+    the intact accumulator, and still return exact counts."""
+    import dataclasses
+
+    n = 40_960
+    snap1 = generate(SyntheticSpec(n_pids=16, n_unique_stacks=n, n_rows=n,
+                                   total_samples=n, mean_depth=8, seed=31))
+    # Every stack exactly once: close picks width 4, predicts 4 again.
+    snap1 = dataclasses.replace(snap1, counts=np.ones(n, np.int64))
+    snap2 = dataclasses.replace(snap1, counts=np.full(n, 20, np.int64))
+
+    d = DictAggregator(capacity=1 << 17)
+    d.feed(snap1)
+    c1 = d.close_window()
+    assert c1.sum() == n
+    d.feed(snap2)
+    c2 = d.close_window()
+    assert d.stats.get("close_retries", 0) >= 1
+    assert int(c2.sum()) == 20 * n
+    assert set(np.unique(c2).tolist()) == {20}
+
+
+def test_dict_streaming_empty_close():
+    d = DictAggregator(capacity=1 << 8)
+    assert d.close_window().tolist() == []
+
+
 def test_dict_empty_window():
     d = DictAggregator(capacity=1 << 8)
     empty = generate(SyntheticSpec(n_pids=2, n_unique_stacks=4, n_rows=0,
