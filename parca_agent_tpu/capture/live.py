@@ -261,7 +261,12 @@ class UnwindTableCache:
                     self._tables[pid] = table
                     self._built_at[pid] = time.monotonic()
                 self.stats["builds"] += 1
-            except OSError:
+            except Exception:
+                # table_for_pid maps known failure classes to OSError, but a
+                # malformed .eh_frame can raise anything (struct.error,
+                # IndexError, MemoryError). Record built_at so the poison pid
+                # is not re-queued every drain, and keep the worker alive for
+                # the other pids.
                 with self._lock:
                     self._built_at[pid] = time.monotonic()
                 self.stats["build_errors"] += 1
@@ -328,7 +333,11 @@ def unwind_records(records_v2, tables: UnwindTableCache,
         frames, depth, st = walk_batch(table, rip, rsp, rbp, stacks, dyn)
         total_stats.add(st)
         for k, i in enumerate(need):
-            d = int(depth[k])
+            # The record's kernel frames stay on the row; the walked user
+            # chain must fit the remaining depth budget or the combined
+            # stack would overflow records_to_snapshot's STACK_SLOTS rows.
+            budget = MAX_STACK_DEPTH - len(records_v2[i][2])
+            d = min(int(depth[k]), budget)
             # Only adopt the walk when it beats the FP chain.
             if d > len(records_v2[i][3]):
                 pid_, tid_, kf, _uf = out[i]
@@ -349,6 +358,10 @@ class PerfEventSampler:
         self._cap = drain_cap_mb << 20
         self._maps = ProcessMapCache()
         self._objs = ObjectFileCache()
+        # One reusable drain buffer: allocating + zeroing drain_cap_mb per
+        # drain pass is pure churn on the capture path; only the n written
+        # bytes are ever read back.
+        self._drainbuf = (ctypes.c_uint8 * self._cap)()
         self.capture_stack = capture_stack
         flags = PA_CAPTURE_USER_STACK if capture_stack else 0
         self._handle = self._lib.pa_sampler_create2(
@@ -383,13 +396,13 @@ class PerfEventSampler:
         chunks = []
         for _ in range(64):  # safety bound; one pass is the norm
             before = self.truncated_drains
-            buf = (ctypes.c_uint8 * self._cap)()
+            buf = self._drainbuf
             n = self._lib.pa_sampler_drain(
                 self._handle, buf, ctypes.c_long(self._cap))
             if n < 0:
                 raise SamplerUnavailable("sampler drain failed")
             if n:
-                chunks.append(bytes(buf[:n]))
+                chunks.append(ctypes.string_at(buf, n))
             if self.truncated_drains == before:
                 break
         return b"".join(chunks)

@@ -12,10 +12,11 @@ iteration advances every still-active sample by one frame (one batched
 binary search + gathered 8-byte reads), the same data-parallel shape as the
 aggregators' mapping join.
 
-Termination mirrors the reference: pc not covered by the table
-(pc_not_covered), unsupported rule (unsupported_expression), return address
-0 or out of the captured slice (truncated), rbp == 0 after a frame-pointer
-row (stack bottom, success — cpu.bpf.c:636-660), or the 127-frame cap.
+Termination mirrors the reference: pc not covered by the table with
+rbp != 0 (pc_not_covered), unsupported rule (unsupported_expression),
+return address 0 or out of the captured slice (truncated), pc not covered
+AND rbp == 0 (stack bottom, success — cpu.bpf.c:636-660), or the
+127-frame cap.
 """
 
 from __future__ import annotations
@@ -112,7 +113,13 @@ def walk_batch(
         idx = lookup_rows(table, np.where(active, lookup_pc, np.uint64(0)))
         covered = idx >= 0
         newly_uncov = active & ~covered
-        done_notcov |= newly_uncov
+        # Stack bottom per the reference (cpu.bpf.c:636-660): success only
+        # when the pc is NOT covered by the table AND rbp == 0. A zero rbp
+        # while the pc is still covered (rbp used as a scratch register
+        # under an UNDEFINED rule) keeps walking.
+        bottom = newly_uncov & (bp == 0) & (depth > 0)
+        done_success |= bottom
+        done_notcov |= newly_uncov & ~bottom
         active &= covered
 
         # Record this frame for samples still walking.
@@ -164,22 +171,26 @@ def walk_batch(
             new_bp[off_rows] = np.where(bp_ok, bp_vals, np.uint64(0))
         keep = off_rows | (rbp_t == RBP_TYPE_UNDEFINED)
 
-        # Advance; classify terminations.
+        # Advance; classify terminations. rbp == 0 does NOT terminate here:
+        # the bottom-of-stack test happens at the next iteration's coverage
+        # check (see above), matching the reference's ordering.
         trunc = ~ok | (ra == 0)
         unsup = ok & ~trunc & ~keep
         done_unsupported[aidx[unsup]] = True
-        # rbp == 0 after a successful frame = stack bottom (success).
-        bottom = ok & ~trunc & keep & (new_bp == 0)
-        done_success[aidx[bottom]] = True
 
-        cont = ~trunc & keep & (new_bp != 0)
+        cont = ~trunc & keep
         active[aidx] = cont
         pc[aidx] = ra
         sp[aidx] = cfa[aidx]
         bp[aidx] = new_bp
 
-    # Samples still active at the frame cap, or that died on a bad read,
-    # are truncated-but-usable prefixes.
+    # Samples still active at the frame cap get one final bottom test (the
+    # loop's coverage check never ran for their last return address); the
+    # rest that died on a bad read are truncated-but-usable prefixes.
+    if active.any():
+        idx = lookup_rows(table, np.where(active, pc - np.uint64(1),
+                                          np.uint64(0)))
+        done_success |= active & (idx < 0) & (bp == 0)
     stats.success = int(done_success.sum())
     stats.pc_not_covered = int((done_notcov & (depth == 0)).sum())
     stats.unsupported = int(done_unsupported.sum())

@@ -135,6 +135,70 @@ def test_walk_read_out_of_dump_truncates():
     assert stats.truncated == 1
 
 
+def test_walk_zero_rbp_under_covered_pc_keeps_walking():
+    """rbp == 0 is only the stack bottom when the pc is NOT table-covered
+    (cpu.bpf.c:636-660); a scratch-register zero under an UNDEFINED rule
+    must not end the walk early (r2 ADVICE)."""
+    rsp0 = 0x7FFF0000
+    table = _table([
+        (0x1000, CFA_TYPE_RSP, RBP_TYPE_UNDEFINED, 8, 0),
+        (0x2000, CFA_TYPE_RSP, RBP_TYPE_UNDEFINED, 8, 0),
+        (0x3000, 4, 0, 0, 0),  # CFA_TYPE_END_OF_FDE sentinel
+    ])
+    # frame0 at 0x1100 with rbp incidentally 0; RA -> 0x2100 (covered, so
+    # the walk continues); frame1's RA -> 0x3100 (END_OF_FDE, uncovered)
+    # with rbp still 0 -> stack bottom, success with TWO frames.
+    mem = _mem(64, **{"0": 0x2100, "8": 0x3100})
+    frames, depth, stats = walk_batch(
+        table,
+        rip=np.array([0x1100], np.uint64),
+        rsp=np.array([rsp0], np.uint64),
+        rbp=np.array([0], np.uint64),
+        stacks=mem[None, :],
+        dyn=np.array([64]),
+    )
+    assert depth[0] == 2
+    assert frames[0, :2].tolist() == [0x1100, 0x2100]
+    assert stats.success == 1
+
+
+def test_unwind_records_clamps_walk_to_kernel_budget():
+    """A deep walked user chain plus kernel frames on the record must fit
+    MAX_STACK_DEPTH or records_to_snapshot raises and the whole window is
+    dropped (r2 ADVICE high)."""
+    from parca_agent_tpu.capture.formats import MAX_STACK_DEPTH
+    from parca_agent_tpu.capture.live import (
+        records_to_snapshot,
+        unwind_records,
+    )
+    from parca_agent_tpu.process.maps import build_mapping_table
+
+    class _StubTables:
+        def __init__(self, t):
+            self._t = t
+
+        def matches(self, pid):
+            return True
+
+        def table_for(self, pid):
+            return self._t
+
+    # One open-ended RSP+8 row covering every pc: each frame's RA read at
+    # [sp] yields 0x1100 again, so the walk only stops at the frame cap.
+    table = _table([(0x1000, CFA_TYPE_RSP, RBP_TYPE_UNDEFINED, 8, 0)])
+    dump = np.frombuffer(
+        struct.pack("<Q", 0x1100) * (MAX_STACK_DEPTH + 8), np.uint8).copy()
+    kframes = np.arange(5, dtype=np.uint64) + np.uint64(0xFFFF800000000000)
+    rec = (9, 9, kframes, np.zeros(0, np.uint64),
+           0x1100, 0, 1, dump)
+    out = unwind_records([rec], _StubTables(table), min_fp_frames=2)
+    assert len(out[0][3]) == MAX_STACK_DEPTH - len(kframes)  # deep walk
+    # The combined record must round-trip into a snapshot without raising.
+    snap = records_to_snapshot(out, build_mapping_table({}), int(1e7),
+                               int(1e10))
+    assert snap.user_len[0] + snap.kernel_len[0] <= MAX_STACK_DEPTH
+
+
 def test_fixture_unwind_table_covers_functions():
     """The compact table built from the checked-in no-FP fixture must cover
     its .text (golden-fixture variant of unwind_table_test.go:26-41)."""
