@@ -236,15 +236,42 @@ class _PidRegistry:
 
 
 class DictAggregator:
-    """Stateful exact aggregation; reuse one instance across windows."""
+    """Stateful exact aggregation; reuse one instance across windows.
+
+    Bounded memory (the role the reference's hard 10,240-entry BPF map cap
+    plays, bpf/cpu/cpu.bpf.c:28-34, which silently DROPS new stacks when
+    full): with overflow="sketch" (default), stacks that arrive once the
+    dictionary is full are absorbed into a host count-min sketch + HLL
+    (approximate counts with known bounds instead of silent loss), and at
+    the next window boundary cold stacks — unseen for rotate_min_age
+    windows — are evicted and their ids recycled, so an always-on agent on
+    a stack-churny host runs in bounded memory indefinitely.
+    overflow="raise" keeps the old fail-fast contract for fixed-population
+    benchmarks."""
 
     name = "dict"
 
-    def __init__(self, capacity: int = 1 << 21, id_cap: int | None = None):
+    def __init__(self, capacity: int = 1 << 21, id_cap: int | None = None,
+                 overflow: str = "sketch",
+                 cm_spec: "CountMinSpec | None" = None,
+                 rotate_min_age: int = 6):
+        from parca_agent_tpu.ops.sketch import CountMinSpec, HLLSpec
+
         if capacity & (capacity - 1):
             raise ValueError("capacity must be a power of two")
+        if overflow not in ("sketch", "raise"):
+            raise ValueError("overflow must be 'sketch' or 'raise'")
         self._cap = capacity
         self._id_cap = id_cap or capacity // 2
+        self._overflow = overflow
+        self._cm_spec = cm_spec or CountMinSpec()
+        self._hll_spec = HLLSpec()
+        self._cm = None                  # lazy [depth, width] int64
+        self._over_hll = None            # lazy [m] int32 registers
+        self._rotate_min_age = rotate_min_age
+        self._rotate_pending = False
+        # Per-id window number the id last had samples (eviction clock).
+        self._last_seen = np.zeros(self._id_cap, np.int32)
         # Host mirror (source of truth).
         self._h1 = np.zeros(capacity, np.uint32)
         self._h2 = np.zeros(capacity, np.uint32)
@@ -296,6 +323,7 @@ class DictAggregator:
             return np.zeros(self._next_id, np.int64)
         if int(snapshot.counts.sum()) >= 2**31:
             raise ValueError("window sample total exceeds int32")
+        self._maybe_rotate()  # window boundary: safe to recycle cold ids
         h1, h2, h3 = hashes if hashes is not None else self.hash_rows(snapshot)
         n_pad = 1 << max(4, (n - 1).bit_length())
         packed = np.zeros((4, n_pad), np.uint32)
@@ -313,7 +341,9 @@ class DictAggregator:
             rows = np.asarray(miss_rows)[:n_miss]
             out = self._handle_misses(snapshot, rows, h1, h2, h3, out)
         self.stats["windows"] += 1
-        return out[: self._next_id]
+        result = out[: self._next_id]
+        self._last_seen[np.flatnonzero(result)] = self.stats["windows"]
+        return result
 
     # -- streaming window protocol -------------------------------------------
     #
@@ -338,6 +368,10 @@ class DictAggregator:
         chunk_total = int(snapshot.counts[lo:hi].sum())
         if self._fed_total + chunk_total >= 2**31:
             raise ValueError("window sample total exceeds int32")
+        if self._needs_reset:
+            # First feed of a new window: the boundary where cold-id
+            # rotation is safe (nothing live indexes stack ids).
+            self._maybe_rotate()
         h1, h2, h3 = hashes if hashes is not None else self.hash_rows(snapshot)
         t0 = _time.perf_counter()
         n_pad = 1 << max(4, (n - 1).bit_length())
@@ -442,8 +476,101 @@ class DictAggregator:
         self._needs_reset = True
         self.stats["windows"] += 1
         out = counts[: self._next_id]
+        self._last_seen[np.flatnonzero(out)] = self.stats["windows"]
         self._prev_counts = out
         return out
+
+    # -- bounded-memory degradation ------------------------------------------
+
+    def _sketch_add(self, hashes: np.ndarray, counts: np.ndarray) -> None:
+        """Absorb overflow rows into the count-min table + HLL registers
+        (bounded memory; overestimate-only error per CountMinSpec)."""
+        from parca_agent_tpu.ops.sketch import cm_buckets, hll_build, hll_merge
+
+        if self._cm is None:
+            self._cm = np.zeros(
+                (self._cm_spec.depth, self._cm_spec.width), np.int64)
+            self._over_hll = np.zeros(self._hll_spec.m, np.int32)
+        b = cm_buckets(hashes, self._cm_spec)
+        for d in range(self._cm_spec.depth):
+            np.add.at(self._cm[d], b[d], counts)
+        self._over_hll = hll_merge(
+            self._over_hll, hll_build(hashes, self._hll_spec))
+        self.stats["sketch_rows"] = \
+            self.stats.get("sketch_rows", 0) + len(hashes)
+        self.stats["sketch_samples"] = \
+            self.stats.get("sketch_samples", 0) + int(counts.sum())
+
+    def sketch_estimate(self, h1_hashes) -> np.ndarray:
+        """Point-query overflow-absorbed counts (CM overestimate bound);
+        zeros when nothing has ever overflowed."""
+        from parca_agent_tpu.ops.sketch import cm_query
+
+        h1_hashes = np.asarray(h1_hashes, np.uint32)
+        if self._cm is None:
+            return np.zeros(len(h1_hashes), np.int64)
+        return cm_query(self._cm, h1_hashes, self._cm_spec).astype(np.int64)
+
+    def sketch_info(self) -> dict:
+        """Observable degradation state (served by the agent's metrics)."""
+        from parca_agent_tpu.ops.sketch import hll_estimate
+
+        return {
+            "sketch_rows": self.stats.get("sketch_rows", 0),
+            "sketch_samples": self.stats.get("sketch_samples", 0),
+            "sketch_distinct_est": (
+                round(hll_estimate(self._over_hll, self._hll_spec))
+                if self._over_hll is not None else 0),
+            "rotations": self.stats.get("rotations", 0),
+        }
+
+    def _maybe_rotate(self) -> None:
+        """Evict stack ids unseen for rotate_min_age windows and recycle
+        their space (registry rotation). Runs only at a window boundary —
+        BEFORE the new window touches the device — so no live accumulator,
+        fetched counts buffer, or profile build is ever indexed by a stale
+        id."""
+        if not self._rotate_pending:
+            return
+        self._rotate_pending = False
+        w = self.stats["windows"]
+        n = self._next_id
+        keep = (w - self._last_seen[:n]) < self._rotate_min_age
+        kept = np.flatnonzero(keep)
+        if len(kept) == n:
+            return  # nothing cold yet; stay in sketch-degraded mode
+        old_to_new = np.full(n, -1, np.int64)
+        old_to_new[kept] = np.arange(len(kept))
+        self._id_pid = [self._id_pid[i] for i in kept]
+        self._id_depth = [self._id_depth[i] for i in kept]
+        self._id_locs = [self._id_locs[i] for i in kept]
+        new_last = np.zeros(self._id_cap, np.int32)
+        new_last[: len(kept)] = self._last_seen[kept]
+        self._last_seen = new_last
+        # Rebuild the key map and the host probe table for the survivors.
+        new_map: dict[tuple, int] = {}
+        self._occ[:] = False
+        self._ids[:] = -1
+        for key, sid in self._key_to_id.items():
+            nid = int(old_to_new[sid])
+            if nid < 0:
+                continue
+            new_map[key] = nid
+            slot = self._host_insert_slot(key)
+            self._occ[slot] = True
+            self._h1[slot], self._h2[slot], self._h3[slot] = key
+            self._ids[slot] = nid
+        self._key_to_id = new_map
+        self._next_id = len(kept)
+        # Per-pid registries with no surviving stacks go too (memory bound).
+        live_pids = set(self._id_pid)
+        self._pids = {p: r for p, r in self._pids.items() if p in live_pids}
+        # Device twin is rebuilt lazily from the host mirror; the open
+        # accumulator is empty at a boundary; width prediction resets.
+        self._dev = None
+        self._acc = None
+        self._prev_counts = None
+        self.stats["rotations"] = self.stats.get("rotations", 0) + 1
 
     # -- internals ----------------------------------------------------------
 
@@ -492,16 +619,25 @@ class DictAggregator:
                 n_new += 1
             classified.append((r, key, existing))
         worst = self._next_id + n_new
+        budget = n_new
         if worst > self._id_cap or worst * 2 > self._cap:
-            raise RuntimeError(
-                f"stack dictionary capacity exhausted "
-                f"({self._next_id} ids + {n_new} new stacks vs "
-                f"id_cap {self._id_cap}, table {self._cap}); "
-                f"construct with a larger capacity"
-            )
+            if self._overflow == "raise":
+                raise RuntimeError(
+                    f"stack dictionary capacity exhausted "
+                    f"({self._next_id} ids + {n_new} new stacks vs "
+                    f"id_cap {self._id_cap}, table {self._cap}); "
+                    f"construct with a larger capacity"
+                )
+            # Degrade instead of dying: insert what fits, absorb the rest
+            # into the count-min/HLL sideband, and ask for a cold-stack
+            # rotation at the next window boundary.
+            budget = max(0, min(self._id_cap, self._cap // 2) - self._next_id)
+            self._rotate_pending = True
 
         new_slots: list[int] = []
         new_rows: list[int] = []
+        absorb_h: list[int] = []
+        absorb_c: list[int] = []
         pending: list[tuple[int, int]] = []  # (sid, count) corrections
         for r, key, existing in classified:
             if existing is None:
@@ -511,6 +647,11 @@ class DictAggregator:
                 self.stats["overflow_misses"] += 1
                 pending.append((existing, int(snapshot.counts[r])))
                 continue
+            if budget <= 0:
+                absorb_h.append(key[0])
+                absorb_c.append(int(snapshot.counts[r]))
+                continue
+            budget -= 1
             slot = self._host_insert_slot(key)
             sid = self._next_id
             self._next_id += 1
@@ -518,10 +659,15 @@ class DictAggregator:
             self._occ[slot] = True
             self._h1[slot], self._h2[slot], self._h3[slot] = key
             self._ids[slot] = sid
+            self._last_seen[sid] = self.stats["windows"] + 1
             new_slots.append(slot)
             new_rows.append(r)
             pending.append((sid, int(snapshot.counts[r])))
             self.stats["inserts"] += 1
+
+        if absorb_h:
+            self._sketch_add(np.array(absorb_h, np.uint32),
+                             np.array(absorb_c, np.int64))
 
         if new_slots:
             self._register_stacks_bulk(snapshot, np.array(new_rows, np.int64))

@@ -174,3 +174,36 @@ def generate(spec: SyntheticSpec) -> WindowSnapshot:
     )
     snap.validate_padding()
     return snap
+
+
+def split_fleet(snap: WindowSnapshot, n_nodes: int, dup_every: int = 3,
+                seed: int = 0) -> list[WindowSnapshot]:
+    """Split one window's rows across n_nodes simulated fleet nodes.
+
+    Every dup_every-th row with count >= 2 lands on TWO nodes with its count
+    split, so cross-node dedup in the fleet merge is exercised for real; the
+    concatenation of the returned windows is count-for-count the original
+    window, which is what makes it the merge-correctness oracle input
+    (BASELINE config #5 test harness, SURVEY.md section 4 closing note)."""
+    rng = np.random.default_rng(seed)
+    n = len(snap)
+    node = rng.integers(0, n_nodes, n).astype(np.int64)
+    dup = (np.arange(n) % dup_every == 0) & (snap.counts >= 2)
+    idx2 = np.flatnonzero(dup)
+    all_idx = np.concatenate([np.arange(n), idx2])
+    all_counts = np.concatenate([
+        np.where(dup, snap.counts // 2, snap.counts),
+        snap.counts[idx2] - snap.counts[idx2] // 2,
+    ])
+    all_node = np.concatenate([node, (node[idx2] + 1) % n_nodes])
+    windows = []
+    for k in range(n_nodes):
+        sel = all_node == k
+        rows = all_idx[sel]
+        windows.append(WindowSnapshot(
+            pids=snap.pids[rows], tids=snap.tids[rows],
+            counts=all_counts[sel], user_len=snap.user_len[rows],
+            kernel_len=snap.kernel_len[rows], stacks=snap.stacks[rows],
+            mappings=snap.mappings, period_ns=snap.period_ns,
+            window_ns=snap.window_ns, time_ns=snap.time_ns))
+    return windows

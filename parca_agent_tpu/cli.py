@@ -42,10 +42,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default=10.0)
     p.add_argument("--local-store-directory", default="")
     p.add_argument("--aggregator", default="cpu",
-                   choices=["cpu", "tpu", "dict"],
+                   choices=["cpu", "tpu", "dict", "dict+cm"],
                    help="window aggregation backend (dict = stateful "
                         "device-resident stack dictionary, the TPU "
-                        "production mode)")
+                        "production mode; dict+cm = bounded-memory dict "
+                        "that degrades overflow to a count-min sketch and "
+                        "rotates cold stacks instead of growing)")
+    p.add_argument("--aggregator-capacity", type=int, default=1 << 21,
+                   help="dict table slots (power of two); dict+cm keeps "
+                        "memory bounded at this size under stack churn")
     p.add_argument("--capture", default="perf",
                    choices=["perf", "procfs", "synthetic", "replay"],
                    help="capture source: perf (native perf_event sampler, "
@@ -183,10 +188,15 @@ def run(argv=None) -> int:
 
         aggregator = TPUAggregator()
         fallback = CPUAggregator()
-    elif args.aggregator == "dict":
+    elif args.aggregator in ("dict", "dict+cm"):
         from parca_agent_tpu.aggregator.dict import DictAggregator
 
-        aggregator = DictAggregator()
+        # Both modes share the implementation; "dict" fails fast at
+        # capacity (fixed-population benchmarking), "dict+cm" degrades to
+        # the count-min sideband + cold-stack rotation (always-on agents).
+        aggregator = DictAggregator(
+            capacity=args.aggregator_capacity,
+            overflow="sketch" if args.aggregator == "dict+cm" else "raise")
         fallback = CPUAggregator()
     else:
         aggregator = CPUAggregator()

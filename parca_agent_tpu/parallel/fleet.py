@@ -15,6 +15,12 @@ the "node" mesh axis:
       Communication is O(total rows); exact, for small fleets/windows and as
       the correctness oracle for the sketch path.
 
+  fleet_merge_profiles — the full config-#5 end state built on the exact
+      path with 64-bit stack ids (fleet_merge_exact64): merged per-id counts
+      from the collective, payload rows joined back on the host from the
+      per-node stack dictionaries, ONE merged WindowSnapshot (union mapping
+      table) and ONE cluster-wide set of per-pid profiles out.
+
 Row liveness is `count > 0`: capture maps never hold zero-count entries, so
 padding (and a dead node's entire shard — SURVEY.md section 5.3 requires the
 merge to tolerate missing nodes) is simply zero counts, which is the
@@ -152,6 +158,171 @@ def _exact_program(mesh):
         out_specs=(P(FLEET_AXIS, None), P(FLEET_AXIS, None), P(FLEET_AXIS)),
     )
     return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=8)
+def _exact_program64(mesh):
+    """Like _exact_program but keyed on TWO uint32 hash lanes (an effective
+    64-bit key). At >=100k rows/node a single 32-bit key collides across the
+    fleet with near-certainty (birthday at ~2^16 rows); two lanes push the
+    collision probability below ~1e-8 at 1M rows while every device column
+    stays an int32/uint32 lane (no x64 on TPU)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def node_fn(h1, h2, counts):
+        a1 = jax.lax.all_gather(h1[0], FLEET_AXIS).reshape(-1)
+        a2 = jax.lax.all_gather(h2[0], FLEET_AXIS).reshape(-1)
+        ac = jax.lax.all_gather(counts[0], FLEET_AXIS).reshape(-1)
+        n = a1.shape[0]
+        h1_s, h2_s, c_s = jax.lax.sort((a1, a2, ac), num_keys=2,
+                                       is_stable=False)
+        first = jnp.concatenate([
+            jnp.ones((1,), bool),
+            (h1_s[1:] != h1_s[:-1]) | (h2_s[1:] != h2_s[:-1]),
+        ])
+        group = jnp.cumsum(first.astype(jnp.int32)) - 1
+        sums = jax.ops.segment_sum(c_s, group, num_segments=n)
+        reps1 = jax.ops.segment_max(h1_s, group, num_segments=n)
+        reps2 = jax.ops.segment_max(h2_s, group, num_segments=n)
+        n_groups = first.astype(jnp.int32).sum()
+        return reps1[None], reps2[None], sums[None], n_groups[None]
+
+    fn = jax.shard_map(
+        node_fn,
+        mesh=mesh,
+        in_specs=(P(FLEET_AXIS, None), P(FLEET_AXIS, None),
+                  P(FLEET_AXIS, None)),
+        out_specs=(P(FLEET_AXIS, None), P(FLEET_AXIS, None),
+                   P(FLEET_AXIS, None), P(FLEET_AXIS)),
+    )
+    return jax.jit(fn)
+
+
+def fleet_merge_exact64(node_h1, node_h2, node_counts, mesh=None):
+    """Exact cross-node dedup on a 64-bit key carried as two uint32 lanes.
+
+    Returns (h1 [U], h2 [U], counts [U]) for rows with nonzero merged
+    count; (h1 << 32 | h2) is the stable cluster-wide stack id the host
+    payload join keys on."""
+    import jax.numpy as jnp
+
+    node_h1, node_counts = _check_streams(node_h1, node_counts)
+    node_h2 = np.asarray(node_h2, np.uint32)
+    if node_h2.shape != node_h1.shape:
+        raise ValueError("node_h2 must be congruent with node_h1")
+    if mesh is None:
+        mesh = fleet_mesh(node_h1.shape[0])
+    prog = _exact_program64(mesh)
+    r1, r2, sums, n_groups = prog(
+        jnp.asarray(node_h1), jnp.asarray(node_h2), jnp.asarray(node_counts))
+    k = int(np.asarray(n_groups)[0])
+    uh1 = np.asarray(r1[0][:k])
+    uh2 = np.asarray(r2[0][:k])
+    uc = np.asarray(sums[0][:k])
+    live = uc > 0
+    return uh1[live], uh2[live], uc[live]
+
+
+def fleet_merge_profiles(node_windows, mesh=None, aggregator=None):
+    """BASELINE config #5 end state: N per-node WindowSnapshots -> ONE
+    cluster-wide profile set (SURVEY.md section 2.12).
+
+    Device (the communication-bound part): each node contributes its
+    compacted (h1, h2, count) stream — never raw 128-slot stacks, per
+    SURVEY section 7 hard part #3 — and one all_gather + sort + segment-sum
+    over the fleet mesh produces the merged per-stack-id counts.
+
+    Host (the payload part): every merged 64-bit stack id is joined back to
+    the (pid, tid, lens, frames) row held by whichever node produced it —
+    the per-node stack dictionary role — the rows are re-assembled into one
+    WindowSnapshot whose mapping table is the union of the node tables, and
+    per-pid profile assembly runs once on the merged window.
+
+    Returns (profiles, merged_snapshot). Identical (pid, stack) rows on
+    different nodes merge into one row with the summed count; distinct rows
+    colliding on the full 64-bit hash would mis-merge, with probability
+    ~1e-8 at 1M fleet rows (see _exact_program64).
+    """
+    from parca_agent_tpu.aggregator.cpu import CPUAggregator
+    from parca_agent_tpu.capture.formats import (
+        STACK_SLOTS,
+        WindowSnapshot,
+        merge_mapping_tables,
+    )
+    from parca_agent_tpu.ops.hashing import row_hash_np
+
+    ws = list(node_windows)
+    if not ws:
+        raise ValueError("fleet_merge_profiles needs at least one window")
+    n_nodes = len(ws)
+    r = max(max(len(w) for w in ws), 1)
+    h1s = np.zeros((n_nodes, r), np.uint32)
+    h2s = np.zeros((n_nodes, r), np.uint32)
+    counts = np.zeros((n_nodes, r), np.int32)
+    node_keys = []
+    for node, w in enumerate(ws):
+        if len(w) == 0:
+            node_keys.append(np.zeros(0, np.uint64))
+            continue
+        h1, h2 = row_hash_np(w.stacks, w.pids, w.user_len, w.kernel_len)
+        h1s[node, : len(w)] = h1
+        h2s[node, : len(w)] = h2
+        counts[node, : len(w)] = w.counts.astype(np.int32)
+        node_keys.append(
+            (h1.astype(np.uint64) << np.uint64(32)) | h2.astype(np.uint64))
+
+    uh1, uh2, uc = fleet_merge_exact64(h1s, h2s, counts, mesh=mesh)
+    ukey = (uh1.astype(np.uint64) << np.uint64(32)) | uh2.astype(np.uint64)
+    u = len(ukey)
+
+    # Join each merged stack id back to a payload row (first node wins;
+    # identical ids hold identical payloads by construction of the hash).
+    src_node = np.full(u, -1, np.int64)
+    src_row = np.zeros(u, np.int64)
+    found = np.zeros(u, bool)
+    for node, keys in enumerate(node_keys):
+        if not len(keys) or found.all():
+            continue
+        order = np.argsort(keys)
+        sk = keys[order]
+        pos = np.searchsorted(sk, ukey)
+        safe = np.clip(pos, 0, len(sk) - 1)
+        hit = (pos < len(sk)) & (sk[safe] == ukey) & ~found
+        src_node[hit] = node
+        src_row[hit] = order[safe[hit]]
+        found |= hit
+    if not found.all():
+        raise RuntimeError(
+            f"{int((~found).sum())} merged stack ids have no payload row"
+        )
+
+    pids = np.zeros(u, np.int32)
+    tids = np.zeros(u, np.int32)
+    ulen = np.zeros(u, np.int32)
+    klen = np.zeros(u, np.int32)
+    stacks = np.zeros((u, STACK_SLOTS), np.uint64)
+    for node, w in enumerate(ws):
+        sel = src_node == node
+        if not sel.any():
+            continue
+        rows = src_row[sel]
+        pids[sel] = w.pids[rows]
+        tids[sel] = w.tids[rows]
+        ulen[sel] = w.user_len[rows]
+        klen[sel] = w.kernel_len[rows]
+        stacks[sel] = w.stacks[rows]
+
+    merged = WindowSnapshot(
+        pids=pids, tids=tids, counts=uc.astype(np.int64),
+        user_len=ulen, kernel_len=klen, stacks=stacks,
+        mappings=merge_mapping_tables([w.mappings for w in ws]),
+        period_ns=ws[0].period_ns, window_ns=ws[0].window_ns,
+        time_ns=min(w.time_ns for w in ws),
+    )
+    agg = aggregator if aggregator is not None else CPUAggregator()
+    return agg.aggregate(merged), merged
 
 
 def fleet_merge_exact(node_hashes, node_counts, mesh=None):

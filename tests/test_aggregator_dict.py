@@ -140,12 +140,73 @@ def test_dict_mapping_change_keeps_registry_ids_valid():
 def test_dict_capacity_guard():
     snap = generate(SyntheticSpec(n_pids=4, n_unique_stacks=100,
                                   total_samples=1000, seed=2))
-    d = DictAggregator(capacity=64)
+    d = DictAggregator(capacity=64, overflow="raise")
     try:
         d.aggregate(snap)
         assert False, "expected capacity error"
     except RuntimeError as e:
         assert "capacity" in str(e) or "half full" in str(e)
+
+
+def test_dict_sketch_degradation_survives_capacity():
+    """r2 VERDICT #3: at capacity the default mode must absorb overflow
+    into the count-min sideband (with its overestimate-only bound) instead
+    of raising, and no sample mass may be lost."""
+    snap = generate(SyntheticSpec(n_pids=4, n_unique_stacks=100,
+                                  total_samples=1000, seed=2))
+    d = DictAggregator(capacity=64)  # id_cap 32 << 100 uniques
+    h1, h2, h3 = d.hash_rows(snap)
+    counts = d.window_counts(snap, (h1, h2, h3))
+    info = d.sketch_info()
+    # Conservation: exact ids + sketch-absorbed samples == window total.
+    assert int(counts.sum()) + info["sketch_samples"] == snap.total_samples()
+    assert info["sketch_rows"] > 0
+    assert info["sketch_distinct_est"] > 0
+    # CM never underestimates: absorbed rows' estimates >= their true count.
+    est = d.sketch_estimate(h1)
+    in_dict = np.array(
+        [(int(h1[i]), int(h2[i]), int(h3[i])) in d._key_to_id
+         for i in range(len(snap))])
+    assert (~in_dict).sum() == info["sketch_rows"]
+    assert np.all(est[~in_dict] >= snap.counts[~in_dict])
+    # Profiles still build and validate for the exact part.
+    for p in d._build_profiles(snap, counts):
+        p.check()
+
+
+def test_dict_rotation_recycles_cold_ids():
+    """Cold stacks (unseen rotate_min_age windows) are evicted at a window
+    boundary and their space recycled, so a stack-churny host runs in
+    bounded memory (r2 VERDICT #3 'registry rotation')."""
+    cap = 1 << 9  # id_cap 256
+    d = DictAggregator(capacity=cap, rotate_min_age=2)
+    prev_sketch = 0
+    for w in range(6):
+        # A fresh 200-unique population every window: permanent churn.
+        snap = generate(SyntheticSpec(
+            n_pids=3, n_unique_stacks=200, n_rows=200,
+            total_samples=2000, seed=100 + w))
+        counts = d.window_counts(snap)
+        assert d._next_id <= d._id_cap  # memory stays bounded
+        info = d.sketch_info()
+        absorbed = info["sketch_samples"] - prev_sketch
+        prev_sketch = info["sketch_samples"]
+        # Per-window conservation: exact + sketch-absorbed == total.
+        assert int(counts.sum()) + absorbed == snap.total_samples()
+    assert d.sketch_info()["rotations"] >= 1
+
+    # A stationary population becomes fully resident (exact again) within
+    # a few windows as rotation clears the cold churn.
+    snap = generate(SyntheticSpec(
+        n_pids=3, n_unique_stacks=100, n_rows=100,
+        total_samples=1000, seed=999))
+    for _ in range(4):
+        counts = d.window_counts(snap)
+        if int(counts.sum()) == snap.total_samples():
+            break
+    assert int(counts.sum()) == snap.total_samples()
+    for p in d._build_profiles(snap, counts):
+        p.check()
 
 
 def test_dict_streaming_feed_close_matches_batch():
