@@ -1,0 +1,40 @@
+# parca-agent-tpu container image (role of the reference's Dockerfile:
+# build the agent, run it as a privileged whole-machine profiler).
+#
+# The agent needs: CAP_PERFMON (or kernel.perf_event_paranoid <= 1) for
+# perf_event capture, the host's /proc mounted at /proc for whole-machine
+# visibility, and — for the TPU aggregation path — the TPU runtime mounted
+# per the platform's device-plugin conventions (libtpu + /dev/accel*).
+#
+# Build:  docker build -t parca-agent-tpu .
+# Run:    docker run --privileged --pid=host -p 7071:7071 parca-agent-tpu
+
+FROM python:3.12-slim AS build
+
+# g++/make compile the native perf_event drain runtime ahead of time so the
+# runtime image needs no toolchain (capture/live.py uses the prebuilt .so).
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY parca_agent_tpu ./parca_agent_tpu
+RUN make -C parca_agent_tpu/native libpasampler.so \
+    && pip install --no-cache-dir wheel \
+    && pip wheel --no-deps -w /wheels .
+
+FROM python:3.12-slim
+
+COPY --from=build /wheels /wheels
+RUN pip install --no-cache-dir /wheels/*.whl \
+    # jax/pyyaml/grpcio are optional extras; install what the deployment
+    # uses. The TPU wheel set is provided by the node image on TPU VMs —
+    # override PARCA_EXTRA_PIP at build time to pin a different set.
+    && pip install --no-cache-dir pyyaml grpcio || true
+# Ship the prebuilt native sampler into the installed package.
+COPY --from=build /src/parca_agent_tpu/native/libpasampler.so \
+     /usr/local/lib/python3.12/site-packages/parca_agent_tpu/native/
+
+EXPOSE 7071
+ENTRYPOINT ["parca-agent-tpu"]
+CMD ["--http-address", "0.0.0.0:7071"]

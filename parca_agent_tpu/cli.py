@@ -77,6 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated units to discover (empty = all)")
     p.add_argument("--enable-systemd-discovery", action="store_true")
     p.add_argument("--enable-cgroup-discovery", action="store_true")
+    p.add_argument("--enable-kubernetes-discovery", action="store_true",
+                   help="watch this node's pods via the in-cluster API and "
+                        "label samples with pod/container metadata "
+                        "(reference pkg/discovery/kubernetes.go)")
     p.add_argument("--windows", type=int, default=0,
                    help="exit after N windows (0 = run forever)")
     p.add_argument("--version", action="version",
@@ -241,6 +245,10 @@ def run(argv=None) -> int:
         from parca_agent_tpu.discovery.cgroup import CgroupContainerDiscoverer
 
         providers["cgroup"] = CgroupContainerDiscoverer()
+    if args.enable_kubernetes_discovery:
+        from parca_agent_tpu.discovery.kubernetes import PodDiscoverer
+
+        providers["kubernetes"] = PodDiscoverer(node=args.node or None)
     discovery.apply_config(providers)
 
     sd_provider = ServiceDiscoveryProvider()
@@ -368,3 +376,8 @@ def run(argv=None) -> int:
         print(f"profiler crashed: {profiler.crashed!r}", file=sys.stderr)
         return 1
     return 0
+
+
+def main() -> None:
+    """Console-script entry point (pyproject [project.scripts])."""
+    raise SystemExit(run())

@@ -1,0 +1,6 @@
+"""Native (C++) runtime pieces shipped as source and built on demand.
+
+`sampler.cc` is the perf_event ring drainer (role of the reference's
+bpf/cpu/cpu.bpf.c capture program); capture/live.py compiles it with the
+adjacent Makefile on first use and loads it via ctypes.
+"""

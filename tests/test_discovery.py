@@ -139,3 +139,125 @@ def test_end_to_end_discovery_to_labels():
     labels = LabelsManager([sd], []).label_set("cpu", 44)
     assert labels["containerid"] == CID
     assert LabelsManager([sd], []).label_set("cpu", 45)["pid"] == "45"
+
+
+# ---- Kubernetes discoverer (fake API + fake cgroup fs; VERDICT r2 #5) ----
+
+POD_LIST_DOC = {
+    "items": [
+        {
+            "metadata": {"name": "web-abc", "namespace": "prod",
+                         "uid": "12345678-1234-1234-1234-123456789012"},
+            "spec": {"nodeName": "node-1"},
+            "status": {"containerStatuses": [
+                {"name": "app", "containerID": f"containerd://{CID}",
+                 "state": {"running": {"startedAt": "2026-01-01T00:00:00Z"}}},
+                {"name": "sidecar", "containerID": f"containerd://{CID2}",
+                 "state": {"running": {}}},
+            ]},
+        },
+        {   # pending pod: no container statuses yet
+            "metadata": {"name": "pending", "namespace": "prod", "uid": "u2"},
+            "spec": {"nodeName": "node-1"},
+            "status": {},
+        },
+    ]
+}
+
+
+def _k8s_fixture():
+    from parca_agent_tpu.discovery.kubernetes import PodDiscoverer, parse_pod_list
+
+    fs = FakeFS({
+        "/proc/10/cgroup": f"0::/kubepods/cri-containerd-{CID}.scope\n".encode(),
+        "/proc/11/cgroup": f"0::/kubepods/cri-containerd-{CID}.scope\n".encode(),
+        "/proc/20/cgroup": b"0::/user.slice\n",
+    })
+    disc = PodDiscoverer(
+        node="node-1",
+        lister=lambda node: parse_pod_list(POD_LIST_DOC),
+        cgroups=CgroupContainerDiscoverer(fs=fs),
+    )
+    return disc
+
+
+def test_pod_discoverer_joins_api_to_local_pids():
+    groups = _k8s_fixture().scrape()
+    # Only the container with local PIDs yields a group; the sidecar has no
+    # cgroup presence here and the pending pod has no containers at all.
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.source == "pod/prod/web-abc/app"
+    assert g.labels["pod"] == "web-abc"
+    assert g.labels["namespace"] == "prod"
+    assert g.labels["container"] == "app"
+    assert g.labels["containerid"] == CID
+    assert g.labels["node"] == "node-1"
+    assert sorted(g.pids) == [10, 11] and g.entry_pid == 10
+
+
+def test_pod_discoverer_end_to_end_labels():
+    """pod watch -> Group -> ServiceDiscoveryProvider -> LabelsManager
+    (the reference's kubernetes.go:76-133 -> labels path, with fakes)."""
+    from parca_agent_tpu.labels.manager import LabelsManager
+    from parca_agent_tpu.metadata.providers import ServiceDiscoveryProvider
+
+    mgr = DiscoveryManager(debounce_s=0.0)
+    mgr._update("kubernetes", _k8s_fixture().scrape())
+    mgr.flush()
+    sd = ServiceDiscoveryProvider()
+    sd.update(mgr.groups())
+    labels = LabelsManager([sd], []).label_set("cpu", 11)
+    assert labels["pod"] == "web-abc"
+    assert labels["container"] == "app"
+
+
+def test_in_cluster_lister_url_and_auth(tmp_path):
+    from parca_agent_tpu.discovery.kubernetes import InClusterPodLister
+
+    (tmp_path / "token").write_text("sekrit\n")
+    seen = {}
+
+    def opener(url, headers):
+        seen["url"], seen["headers"] = url, headers
+        import json
+
+        return json.dumps(POD_LIST_DOC).encode()
+
+    lister = InClusterPodLister(
+        sa_dir=str(tmp_path),
+        env={"KUBERNETES_SERVICE_HOST": "10.0.0.1",
+             "KUBERNETES_SERVICE_PORT": "443"},
+        opener=opener)
+    pods = lister("node-1")
+    assert seen["url"] == ("https://10.0.0.1:443/api/v1/pods"
+                           "?fieldSelector=spec.nodeName%3Dnode-1")
+    assert seen["headers"]["Authorization"] == "Bearer sekrit"
+    assert pods[0].name == "web-abc"
+    assert pods[0].containers[0].container_id == CID
+
+
+def test_in_cluster_lister_requires_cluster_env():
+    import pytest
+
+    from parca_agent_tpu.discovery.kubernetes import InClusterPodLister
+
+    with pytest.raises(RuntimeError, match="KUBERNETES_SERVICE_HOST"):
+        InClusterPodLister(env={})
+
+
+def test_parse_pod_list_strips_runtime_prefixes():
+    from parca_agent_tpu.discovery.kubernetes import parse_pod_list
+
+    doc = {"items": [{
+        "metadata": {"name": "p", "namespace": "d", "uid": "u"},
+        "spec": {"nodeName": "n"},
+        "status": {"containerStatuses": [
+            {"name": "c1", "containerID": f"docker://{CID}",
+             "state": {"running": {}}},
+            {"name": "c2", "containerID": "",  # not started
+             "state": {"waiting": {}}},
+        ]},
+    }]}
+    pods = parse_pod_list(doc)
+    assert [c.container_id for c in pods[0].containers] == [CID]

@@ -1,0 +1,79 @@
+"""Packaging / deploy artifacts (SURVEY.md §2.9; VERDICT r2 missing #1).
+
+The reference ships as a static binary + Dockerfile + jsonnet DaemonSet;
+this package ships as a wheel with a console script, a Dockerfile, and a
+plain-YAML DaemonSet. These tests pin the contracts that `pip install .`
+relies on without shelling out to pip (the offline install itself is
+exercised manually / in CI: pip install --no-build-isolation --no-index .).
+"""
+
+import os
+import tomllib
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pyproject():
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        return tomllib.load(f)
+
+
+def test_console_script_target_exists():
+    cfg = _pyproject()
+    target = cfg["project"]["scripts"]["parca-agent-tpu"]
+    mod_name, func_name = target.split(":")
+    import importlib
+
+    mod = importlib.import_module(mod_name)
+    assert callable(getattr(mod, func_name))
+
+
+def test_version_single_source():
+    import parca_agent_tpu
+
+    assert _pyproject()["project"]["version"] == parca_agent_tpu.__version__
+
+
+def test_native_source_ships_as_package_data():
+    cfg = _pyproject()
+    data = cfg["tool"]["setuptools"]["package-data"]["parca_agent_tpu.native"]
+    assert "*.cc" in data and "Makefile" in data
+    # The files the Makefile needs must exist where package-data points.
+    native = os.path.join(REPO, "parca_agent_tpu", "native")
+    assert os.path.exists(os.path.join(native, "sampler.cc"))
+    assert os.path.exists(os.path.join(native, "Makefile"))
+    assert os.path.exists(os.path.join(native, "__init__.py"))
+
+
+def test_daemonset_manifest_well_formed():
+    yaml = pytest.importorskip("yaml")
+    with open(os.path.join(REPO, "deploy", "daemonset.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    kinds = {d["kind"] for d in docs}
+    assert {"DaemonSet", "ServiceAccount", "ClusterRole",
+            "ClusterRoleBinding"} <= kinds
+    ds = next(d for d in docs if d["kind"] == "DaemonSet")
+    spec = ds["spec"]["template"]["spec"]
+    # Whole-machine profiling needs the host PID namespace and privilege.
+    assert spec["hostPID"] is True
+    agent = spec["containers"][0]
+    assert agent["securityContext"]["privileged"] is True
+    # Every arg the manifest passes must be a flag the CLI knows.
+    from parca_agent_tpu.cli import build_parser
+
+    parser = build_parser()
+    known = {opt for action in parser._actions
+             for opt in action.option_strings}
+    for arg in agent["args"]:
+        flag = arg.split("=", 1)[0]
+        assert flag in known, f"daemonset passes unknown flag {flag}"
+
+
+def test_dockerfile_builds_native_and_installs_wheel():
+    with open(os.path.join(REPO, "Dockerfile")) as f:
+        text = f.read()
+    assert "libpasampler.so" in text
+    assert "pip wheel" in text or "pip install" in text
+    assert "ENTRYPOINT" in text
