@@ -14,6 +14,9 @@ import time
 from typing import Protocol
 
 from parca_agent_tpu.agent.profilestore import RawSeries
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("batch")
 
 
 class StoreClient(Protocol):
@@ -84,10 +87,12 @@ class BatchWriteClient:
                 self._client.write_raw(batch, normalized=True)
                 self.sent_batches += 1
                 return True
-            except Exception:
+            except Exception as e:
                 self.send_errors += 1
                 if self._clock() + backoff >= deadline or self._stop.is_set():
                     self._restore(batch)
+                    _log.warn("batch write failed; will retry next interval",
+                              series=len(batch), error=repr(e))
                     return False
                 self._sleep(backoff)
                 backoff = min(backoff * 2, self._interval)

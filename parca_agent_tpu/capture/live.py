@@ -46,6 +46,9 @@ from parca_agent_tpu.capture.formats import (
 )
 from parca_agent_tpu.process.maps import ProcessMapCache, build_mapping_table
 from parca_agent_tpu.process.objectfile import ObjectFileCache
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("capture")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _LIB = os.path.join(_NATIVE_DIR, "libpasampler.so")
@@ -261,7 +264,7 @@ class UnwindTableCache:
                     self._tables[pid] = table
                     self._built_at[pid] = time.monotonic()
                 self.stats["builds"] += 1
-            except Exception:
+            except Exception as e:
                 # table_for_pid maps known failure classes to OSError, but a
                 # malformed .eh_frame can raise anything (struct.error,
                 # IndexError, MemoryError). Record built_at so the poison pid
@@ -270,6 +273,8 @@ class UnwindTableCache:
                 with self._lock:
                     self._built_at[pid] = time.monotonic()
                 self.stats["build_errors"] += 1
+                _log.warn("unwind table build failed", pid=pid,
+                          error=repr(e))
             finally:
                 with self._lock:
                     self._qset.discard(pid)

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import argparse
 import signal
-import sys
 import threading
 
 from parca_agent_tpu import __version__
@@ -101,6 +100,11 @@ def _parse_external_labels(text: str) -> dict[str, str]:
 def run(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    from parca_agent_tpu.utils.log import get_logger, setup_logging
+
+    setup_logging(args.log_level)
+    log = get_logger("cli")
+
     from parca_agent_tpu.agent.batch import BatchWriteClient, NoopStoreClient
     from parca_agent_tpu.agent.listener import MatchingProfileListener
     from parca_agent_tpu.agent.writer import FileProfileWriter, RemoteProfileWriter
@@ -124,14 +128,13 @@ def run(argv=None) -> int:
     # -- env checks (reference main.go:174-191) -----------------------------
     ok, missing, advisory = check_profiling_enabled()
     if not ok:
-        print(f"kernel config missing required options: {missing}",
-              file=sys.stderr)
+        log.warn("kernel config missing required options", missing=missing)
     if advisory:
-        print(f"kernel config missing advisory (eBPF capture) options: "
-              f"{advisory}", file=sys.stderr)
+        log.warn("kernel config missing advisory (eBPF capture) options",
+                 missing=advisory)
     if is_in_container():
-        print("running inside a container; host procfs must be mounted "
-              "for whole-machine profiling", file=sys.stderr)
+        log.info("running inside a container; host procfs must be mounted "
+                 "for whole-machine profiling")
 
     # -- capture source ------------------------------------------------------
     if args.capture == "replay":
@@ -176,8 +179,8 @@ def run(argv=None) -> int:
         except SamplerUnavailable as e:
             # Fall back the way the reference degrades when BPF features
             # are unavailable: keep profiling with the weaker source.
-            print(f"perf capture unavailable ({e}); falling back to procfs",
-                  file=sys.stderr)
+            log.warn("perf capture unavailable; falling back to procfs",
+                     error=str(e))
             from parca_agent_tpu.capture.procfs import ProcfsSampler
 
             source = ProcfsSampler(
@@ -354,8 +357,8 @@ def run(argv=None) -> int:
     http.start()
     for t in threads:
         t.start()
-    print(f"parca-agent-tpu listening on {args.http_address} "
-          f"(aggregator={args.aggregator}, capture={args.capture})")
+    log.info("parca-agent-tpu listening", address=args.http_address,
+             aggregator=args.aggregator, capture=args.capture)
 
     try:
         while not stop.is_set() and profiler_thread.is_alive() \
@@ -373,7 +376,7 @@ def run(argv=None) -> int:
             debuginfo.close()
         http.stop()
     if profiler.crashed is not None:
-        print(f"profiler crashed: {profiler.crashed!r}", file=sys.stderr)
+        log.error("profiler crashed", exc=profiler.crashed)
         return 1
     return 0
 

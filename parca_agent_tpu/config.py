@@ -11,6 +11,9 @@ from typing import Callable
 import yaml
 
 from parca_agent_tpu.labels.relabel import RelabelConfig
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("config")
 
 
 @dataclasses.dataclass
@@ -68,12 +71,16 @@ class ConfigReloader:
         self._last_content = content
         try:
             cfg = load_config(content.decode())
-        except Exception:
+        except Exception as e:
             self.errors += 1
+            _log.warn("config reload failed; keeping previous config",
+                      path=self._path, error=repr(e))
             return False
         for cb in self._callbacks:
             cb(cfg)
         self.reloads += 1
+        _log.info("config reloaded", path=self._path,
+                  relabel_rules=len(cfg.relabel_configs))
         return True
 
     def run(self) -> None:

@@ -26,6 +26,9 @@ from parca_agent_tpu.debuginfo.extract import extract_debuginfo
 from parca_agent_tpu.debuginfo.find import Finder
 from parca_agent_tpu.elf.reader import ElfError, ElfFile
 from parca_agent_tpu.process.maps import host_path
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("debuginfo")
 from parca_agent_tpu.utils.vfs import VFS, RealFS
 
 
@@ -131,10 +134,12 @@ class DebuginfoManager:
             with self._lock:
                 self._exists.add(build_id)
                 self.stats.uploaded += 1
-        except Exception:
+        except Exception as e:
             with self._lock:
                 self._failed[build_id] = self._clock()
                 self.stats.errors += 1
+            _log.warn("debuginfo upload failed", build_id=build_id,
+                      error=repr(e))
 
     def _debug_payload(self, pid: int, path: str, build_id: str) -> bytes | None:
         try:

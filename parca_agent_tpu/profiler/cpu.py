@@ -23,6 +23,9 @@ from typing import Callable, Protocol
 from parca_agent_tpu.aggregator.base import Aggregator, PidProfile
 from parca_agent_tpu.capture.formats import WindowSnapshot
 from parca_agent_tpu.pprof.builder import build_pprof
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("profiler")
 
 
 class CaptureSource(Protocol):
@@ -84,9 +87,12 @@ class CPUProfiler:
         t0 = time.perf_counter()
         try:
             profiles = self._aggregator.aggregate(snapshot)
-        except Exception:
+        except Exception as e:
             if self._fallback is None:
                 raise
+            _log.warn("device aggregation failed; using CPU fallback",
+                      aggregator=type(self._aggregator).__name__,
+                      error=repr(e))
             profiles = self._fallback.aggregate(snapshot)
         self.metrics.last_aggregate_duration_s = time.perf_counter() - t0
         return profiles
@@ -102,6 +108,8 @@ class CPUProfiler:
             # backoff before the retry.
             self.last_error = e
             self.metrics.errors_total += 1
+            _log.warn("capture poll failed; retrying next window",
+                      error=repr(e))
             return True
         if snapshot is None:
             return False
@@ -131,9 +139,13 @@ class CPUProfiler:
                         objs.append((pid, path, bid))
                 self._debuginfo.ensure_uploaded(objs)
             self.last_error = None
+            _log.debug("window aggregated",
+                       pids=len(profiles),
+                       samples=int(snapshot.total_samples()))
         except Exception as e:  # non-fatal (cpu.go:326-330)
             self.last_error = e
             self.metrics.errors_total += 1
+            _log.warn("profile iteration failed", error=repr(e))
         self.metrics.last_attempt_duration_s = time.perf_counter() - t_start
         if self._on_iteration is not None:
             self._on_iteration(self.metrics.attempts_total)

@@ -1,0 +1,164 @@
+"""Self-profiling: the agent samples its own threads into pprof.
+
+Role of the reference's /debug/pprof/* endpoints and per-component
+runtimepprof labels (cmd/parca-agent/main.go:269-275,256): operators
+profile the profiler. Go gets this from its runtime; here the agent
+runtime is Python threads over native/JAX calls, so the self-profiler is
+a sampling wall-clock profiler over `sys._current_frames()` — every
+actor thread (profiler, batch, http, discovery-*) is attributed by its
+thread name via a `thread` sample label, the analog of the reference's
+`component` profile labels.
+
+The output is standard gzipped profile.proto with function/line info, so
+any pprof consumer (including this repo's parse_pprof) reads it. Building
+it exercises the same wire codec the main profile path uses.
+"""
+
+from __future__ import annotations
+
+import gzip
+import sys
+import threading
+import time
+
+from parca_agent_tpu.pprof import proto
+from parca_agent_tpu.pprof.builder import (
+    F_FILENAME,
+    F_ID,
+    F_NAME,
+    F_SYSTEM_NAME,
+    L_KEY,
+    L_STR,
+    LINE_FUNCTION_ID,
+    LINE_LINE,
+    LOC_ID,
+    LOC_LINE,
+    P_DURATION_NANOS,
+    P_FUNCTION,
+    P_LOCATION,
+    P_PERIOD,
+    P_PERIOD_TYPE,
+    P_SAMPLE,
+    P_SAMPLE_TYPE,
+    P_STRING_TABLE,
+    P_TIME_NANOS,
+    S_LABEL,
+    S_LOCATION_ID,
+    S_VALUE,
+    VT_TYPE,
+    VT_UNIT,
+    _Strings,
+)
+
+MAX_SELF_DEPTH = 127  # same stack budget as the capture path
+
+
+def collect_samples(duration_s: float, hz: float = 100.0,
+                    frames_fn=None, clock=time.monotonic,
+                    sleep=time.sleep) -> dict:
+    """Sample all threads' Python stacks for duration_s at hz.
+
+    Returns {(thread_name, leaf-first ((file, func, line), ...)): count}.
+    frames_fn/clock/sleep are injectable for tests.
+    """
+    frames_fn = frames_fn or sys._current_frames
+    me = threading.get_ident()
+    counts: dict = {}
+    period = 1.0 / hz
+    deadline = clock() + duration_s
+    while clock() < deadline:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for ident, frame in frames_fn().items():
+            if ident == me:
+                continue  # don't profile the profiling thread
+            stack = []
+            f = frame
+            while f is not None and len(stack) < MAX_SELF_DEPTH:
+                code = f.f_code
+                stack.append((code.co_filename, code.co_name, f.f_lineno))
+                f = f.f_back
+            if not stack:
+                continue
+            key = (names.get(ident, f"thread-{ident}"), tuple(stack))
+            counts[key] = counts.get(key, 0) + 1
+        sleep(period)
+    return counts
+
+
+def build_self_pprof(counts: dict, duration_s: float, hz: float = 100.0,
+                     time_ns: int | None = None,
+                     compress: bool = True) -> bytes:
+    """Encode collected samples as profile.proto: samples/count +
+    cpu/nanoseconds values, leaf-first locations with function+line."""
+    st = _Strings()
+    w = proto.Writer()
+
+    for typ, unit in (("samples", "count"), ("cpu", "nanoseconds")):
+        vt = proto.Writer().varint(VT_TYPE, st(typ)).varint(VT_UNIT, st(unit))
+        w.message(P_SAMPLE_TYPE, vt.buf)
+
+    period_ns = int(1e9 / hz)
+    func_ids: dict[tuple[str, str], int] = {}
+    loc_ids: dict[tuple[int, int], int] = {}
+    functions: list[tuple[str, str]] = []
+    locations: list[tuple[int, int]] = []
+
+    def loc_for(file: str, func: str, line: int) -> int:
+        fkey = (file, func)
+        fid = func_ids.get(fkey)
+        if fid is None:
+            fid = func_ids[fkey] = len(functions) + 1
+            functions.append(fkey)
+        lkey = (fid, line)
+        lid = loc_ids.get(lkey)
+        if lid is None:
+            lid = loc_ids[lkey] = len(locations) + 1
+            locations.append(lkey)
+        return lid
+
+    for (thread_name, stack), n in sorted(
+            counts.items(), key=lambda kv: -kv[1]):
+        sw = proto.Writer()
+        sw.packed(S_LOCATION_ID,
+                  [loc_for(f, fn, ln) for f, fn, ln in stack])
+        sw.packed(S_VALUE, [n, n * period_ns])
+        lw = proto.Writer().varint(L_KEY, st("thread")).varint(
+            L_STR, st(thread_name))
+        proto.put_tag_bytes(sw.buf, S_LABEL, bytes(lw.buf))
+        w.message(P_SAMPLE, sw.buf)
+
+    for lid, (fid, line) in enumerate(locations, 1):
+        lw = proto.Writer().varint(LOC_ID, lid)
+        lnw = proto.Writer().varint(LINE_FUNCTION_ID, fid).varint(
+            LINE_LINE, line)
+        lw.message(LOC_LINE, lnw.buf)
+        w.message(P_LOCATION, lw.buf)
+
+    for fid, (file, func) in enumerate(functions, 1):
+        fw = (proto.Writer()
+              .varint(F_ID, fid)
+              .varint(F_NAME, st(func))
+              .varint(F_SYSTEM_NAME, st(func))
+              .varint(F_FILENAME, st(file)))
+        w.message(P_FUNCTION, fw.buf)
+
+    pt = proto.Writer().varint(VT_TYPE, st("cpu")).varint(
+        VT_UNIT, st("nanoseconds"))
+    for s in st.table:
+        proto.put_tag_bytes(w.buf, P_STRING_TABLE, s.encode())
+    w.varint(P_TIME_NANOS,
+             time_ns if time_ns is not None else time.time_ns())
+    w.varint(P_DURATION_NANOS, int(duration_s * 1e9))
+    w.message(P_PERIOD_TYPE, pt.buf)
+    w.varint(P_PERIOD, period_ns)
+
+    data = w.getvalue()
+    return gzip.compress(data, 6) if compress else data
+
+
+def profile_self(duration_s: float = 10.0, hz: float = 100.0) -> bytes:
+    """One-call self profile: sample then encode (the /debug/pprof/profile
+    handler body)."""
+    t0 = time.time_ns()
+    counts = collect_samples(duration_s, hz)
+    return build_self_pprof(counts, duration_s, hz, time_ns=t0)

@@ -118,8 +118,51 @@ class AgentHTTPServer:
                     self._send(200, b"ok\n")
                 elif url.path == "/query":
                     self._query(url)
+                elif url.path.startswith("/debug/pprof"):
+                    self._debug_pprof(url)
                 else:
                     self._send(404, b"not found\n")
+
+            def _debug_pprof(self, url):
+                """Self-profiling endpoints (reference main.go:269-275):
+                the agent profiles its own threads into pprof."""
+                params = dict(urllib.parse.parse_qsl(url.query))
+                name = url.path.removeprefix("/debug/pprof").strip("/")
+                if name == "":
+                    self._send(200, (
+                        b"self-profile endpoints:\n"
+                        b"  /debug/pprof/profile?seconds=N  "
+                        b"sampling wall-clock profile of the agent\n"
+                        b"  /debug/pprof/cmdline            "
+                        b"agent command line\n"))
+                elif name == "cmdline":
+                    import sys as _sys
+
+                    self._send(200, "\x00".join(_sys.argv).encode())
+                elif name == "profile":
+                    from parca_agent_tpu.profiler.selfprofile import (
+                        profile_self,
+                    )
+
+                    try:
+                        seconds = float(params.get("seconds", "10"))
+                    except ValueError:
+                        self._send(400, b"bad seconds parameter\n")
+                        return
+                    if not 0 < seconds <= 300:
+                        self._send(400, b"seconds must be in (0, 300]\n")
+                        return
+                    body = profile_self(seconds)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Disposition",
+                                     'attachment; filename="profile.pb.gz"')
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._send(404, b"unknown profile\n")
 
             def _query(self, url):
                 if outer.listener is None:

@@ -1,0 +1,139 @@
+"""Self-profiling: the agent profiles its own threads
+(reference /debug/pprof/*, cmd/parca-agent/main.go:269-275)."""
+
+import threading
+import urllib.error
+import urllib.request
+
+from parca_agent_tpu.pprof.builder import parse_pprof
+from parca_agent_tpu.profiler.selfprofile import (
+    build_self_pprof,
+    collect_samples,
+    profile_self,
+)
+
+
+def _busy(stop):
+    while not stop.is_set():
+        sum(i * i for i in range(500))
+
+
+def test_collect_samples_sees_other_threads():
+    stop = threading.Event()
+    t = threading.Thread(target=_busy, args=(stop,), name="busy-worker")
+    t.start()
+    try:
+        counts = collect_samples(0.25, hz=200)
+    finally:
+        stop.set()
+        t.join()
+    names = {thread for thread, _ in counts}
+    assert "busy-worker" in names
+    busy_stacks = [s for (th, s) in counts if th == "busy-worker"]
+    assert any(any(fn == "_busy" for _, fn, _ in stack)
+               for stack in busy_stacks)
+    # Leaf-first: the outermost frame of a thread is the thread bootstrap.
+    outermost = busy_stacks[0][-1]
+    assert "threading" in outermost[0] or "_bootstrap" in outermost[1]
+
+
+def test_collect_samples_excludes_self():
+    counts = collect_samples(0.05, hz=100)
+    for (_, stack) in counts:
+        assert not any(fn == "collect_samples" for _, fn, _ in stack)
+
+
+def test_build_self_pprof_roundtrip():
+    counts = {
+        ("worker", (("/a.py", "leaf", 3), ("/a.py", "caller", 9))): 7,
+        ("batch", (("/b.py", "send", 12),)): 2,
+    }
+    prof = parse_pprof(build_self_pprof(counts, duration_s=1.0, hz=100,
+                                        time_ns=123))
+    assert prof.sample_types == \
+        [("samples", "count"), ("cpu", "nanoseconds")]
+    assert prof.period == 10_000_000  # 100 Hz in ns
+    assert prof.duration_nanos == 1_000_000_000 and prof.time_nanos == 123
+
+    by_thread = {lbl["thread"]: (locs, vals)
+                 for locs, vals, lbl in prof.samples}
+    locs, vals = by_thread["worker"]
+    assert vals == (7, 7 * 10_000_000)
+    # leaf-first location chain resolves through line -> function -> name
+    fn_names = []
+    for lid in locs:
+        (fid, line), = prof.locations[lid]["lines"]
+        fn_names.append((prof.functions[fid]["name"], line))
+    assert fn_names == [("leaf", 3), ("caller", 9)]
+    assert by_thread["batch"][1] == (2, 2 * 10_000_000)
+
+
+def test_profile_self_end_to_end():
+    stop = threading.Event()
+    t = threading.Thread(target=_busy, args=(stop,), name="busy-e2e")
+    t.start()
+    try:
+        data = profile_self(duration_s=0.2, hz=200)
+    finally:
+        stop.set()
+        t.join()
+    prof = parse_pprof(data)
+    assert prof.samples
+    threads = {lbl["thread"] for _, _, lbl in prof.samples}
+    assert "busy-e2e" in threads
+
+
+def test_debug_pprof_http_endpoint():
+    """Curl-the-endpoint parity: a live server serves a valid pprof of
+    the agent's own threads."""
+    from parca_agent_tpu.web import AgentHTTPServer
+
+    srv = AgentHTTPServer("127.0.0.1", 0)
+    srv.start()
+    stop = threading.Event()
+    t = threading.Thread(target=_busy, args=(stop,), name="busy-http")
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(
+                f"{base}/debug/pprof/profile?seconds=0.2", timeout=10) as r:
+            data = r.read()
+        prof = parse_pprof(data)
+        assert any(lbl.get("thread") == "busy-http"
+                   for _, _, lbl in prof.samples)
+        with urllib.request.urlopen(f"{base}/debug/pprof/", timeout=5) as r:
+            assert b"profile" in r.read()
+        with urllib.request.urlopen(f"{base}/debug/pprof/cmdline",
+                                    timeout=5) as r:
+            assert r.read()  # \0-joined argv
+    finally:
+        stop.set()
+        t.join()
+        srv.stop()
+
+
+def test_debug_pprof_rejects_bad_seconds():
+    from parca_agent_tpu.web import AgentHTTPServer
+
+    srv = AgentHTTPServer("127.0.0.1", 0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        for q in ("seconds=abc", "seconds=0", "seconds=301"):
+            try:
+                urllib.request.urlopen(
+                    f"{base}/debug/pprof/profile?{q}", timeout=5)
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+    finally:
+        srv.stop()
+
+
+def test_parse_pprof_reads_location_lines():
+    # parse_pprof must expose lines for the self-profile assertions above;
+    # guard that contract here so builder refactors keep it.
+    counts = {("t", (("/x.py", "f", 1),)): 1}
+    prof = parse_pprof(build_self_pprof(counts, 0.1))
+    lid = prof.samples[0][0][0]
+    assert "lines" in prof.locations[lid]
