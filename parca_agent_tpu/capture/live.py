@@ -229,7 +229,7 @@ class UnwindTableCache:
             return True
         return bool(self._regex.search(self._comm(pid)))
 
-    def table_for(self, pid: int) -> np.ndarray | None:
+    def table_for(self, pid: int):
         """The pid's table if built; queues a (re)build when missing or
         stale. Never blocks the drain path."""
         now = time.monotonic()
@@ -257,9 +257,16 @@ class UnwindTableCache:
                 if self._stop:
                     return
                 pid = self._queue.pop(0)
+            from parca_agent_tpu.unwind.table import ShardedTable
+
             try:
                 maps = self._maps.executable_mappings(pid)
-                table = self._builder.table_for_pid(pid, maps)
+                # Store range-partitioned (the reference's (pid, shard)
+                # layout, maps.go:286-395): the walker's two-level lookup
+                # consumes shards directly, and huge processes keep full
+                # coverage (no 3-shard truncation; see shard_table).
+                table = ShardedTable.from_table(
+                    self._builder.table_for_pid(pid, maps))
                 with self._lock:
                     self._tables[pid] = table
                     self._built_at[pid] = time.monotonic()
@@ -279,13 +286,16 @@ class UnwindTableCache:
                 with self._lock:
                     self._qset.discard(pid)
 
-    def build_now(self, pid: int) -> np.ndarray | None:
+    def build_now(self, pid: int):
         """Synchronous build (tests / tools)."""
+        from parca_agent_tpu.unwind.table import ShardedTable
+
         try:
             maps = self._maps.executable_mappings(pid)
         except OSError:
             return None
-        table = self._builder.table_for_pid(pid, maps)
+        table = ShardedTable.from_table(
+            self._builder.table_for_pid(pid, maps))
         with self._lock:
             self._tables[pid] = table
             self._built_at[pid] = time.monotonic()
@@ -299,12 +309,17 @@ class UnwindTableCache:
 
 
 def unwind_records(records_v2, tables: UnwindTableCache,
-                   min_fp_frames: int = 2, stats=None):
+                   trust_fp_frames: int | None = None, stats=None):
     """v2 records -> v1-shaped records with DWARF-walked user stacks.
 
-    Per pid: samples whose frame-pointer chain already looks healthy
-    (>= min_fp_frames user frames) keep it; the rest are batch-unwound
-    against the pid's table when one exists (FP chain kept as fallback).
+    Every register-carrying sample of a table-matched pid is batch-walked
+    and the LONGER of the walked vs frame-pointer chain wins — the
+    reference likewise runs its DWARF walker instead of the FP path for
+    every sample of a targeted process (cpu.bpf.c:724-757); walking only
+    short FP chains would keep truncated mixed stacks (an FP-built leaf
+    over a frameless caller stops the FP chain early yet still has >= 2
+    frames). trust_fp_frames is a throughput knob: samples whose FP chain
+    already has that many frames skip the walk (None = walk all).
     """
     from parca_agent_tpu.unwind.walker import WalkStats, walk_batch
 
@@ -316,8 +331,9 @@ def unwind_records(records_v2, tables: UnwindTableCache,
     total_stats = stats if stats is not None else WalkStats()
     for pid, idxs in by_pid.items():
         need = [i for i in idxs
-                if len(records_v2[i][3]) < min_fp_frames
-                and records_v2[i][4] != 0]
+                if records_v2[i][4] != 0
+                and (trust_fp_frames is None
+                     or len(records_v2[i][3]) < trust_fp_frames)]
         if not need or not tables.matches(pid):
             continue
         table = tables.table_for(pid)

@@ -319,6 +319,10 @@ def run(argv=None) -> int:
             out["parca_agent_dwarf_walk_pc_not_covered_total"] = \
                 ws.pc_not_covered
             out["parca_agent_dwarf_walk_unsupported_total"] = ws.unsupported
+            # Headline quality number (reference anecdote: ~97%,
+            # docs/native-stack-walking/hacking.md:8-17).
+            out["parca_agent_dwarf_walk_success_ratio"] = \
+                round(ws.success / ws.total, 4)
         return out
 
     host, _, port = args.http_address.rpartition(":")

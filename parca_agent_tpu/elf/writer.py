@@ -5,26 +5,28 @@ filtering_elfwriter.go): compose a valid ELF image containing a filtered
 subset of an input file's sections — the mechanism behind debuginfo
 extraction ("strip to only what symbolization needs", extract.go:46-123).
 
-Layout produced: ELF header | section bodies | .shstrtab | section header
-table. Program headers are not emitted: extracted debug files are consumed
-by symbolizers through the section table (same consumption path the
-reference's own extractor output serves); the original e_type/entry are
-preserved so base computation against the paired runtime binary still
-works from the original file.
+Layout produced: ELF header | program headers | section bodies | .shstrtab
+| section header table. Program headers are copied from the source file
+verbatim (vaddr/offset/filesz as originally linked, the eu-strip debug-file
+convention, reference elfwriter.go:64-790 writeSegments role): the
+extracted file is not loadable, but elfexec-style base computation
+(elf/base.py compute_base, pprof GetBase) reads the executable PT_LOAD's
+vaddr and offset from the DEBUG file when the runtime binary is gone, so
+those values must survive extraction unchanged.
 """
 
 from __future__ import annotations
 
 import struct
 
-from parca_agent_tpu.elf.reader import ElfFile, Section, SHT_NOBITS
+from parca_agent_tpu.elf.reader import ElfFile, Section, Segment, SHT_NOBITS
 
 SHT_NULL = 0
 SHT_STRTAB = 3
 
 
 class ElfWriter:
-    """Collect (section, data) pairs, then serialize."""
+    """Collect (section, data) pairs + verbatim segments, then serialize."""
 
     def __init__(self, e_type: int, e_machine: int, entry: int = 0,
                  endian: str = "<"):
@@ -33,12 +35,18 @@ class ElfWriter:
         self.entry = entry
         self.end = endian
         self._sections: list[tuple[Section, bytes]] = []
+        self._segments: list[Segment] = []
 
     def add_section(self, sec: Section, data: bytes) -> None:
         self._sections.append((sec, data))
 
+    def add_segment(self, seg: Segment) -> None:
+        """Record a program header to emit as-is (original offsets/vaddrs;
+        see module docstring for why they are not remapped)."""
+        self._segments.append(seg)
+
     def serialize(self) -> bytes:
-        ehsize, shentsize = 64, 64
+        ehsize, shentsize, phentsize = 64, 64, 56
         # Section name string table; index 0 is the empty name.
         names = bytearray(b"\x00")
         name_off = {}
@@ -48,9 +56,11 @@ class ElfWriter:
         shstr_name_off = len(names)
         names += b".shstrtab\x00"
 
-        # Body layout after the ELF header, honoring alignment.
+        # Body layout after the ELF header and program header table,
+        # honoring alignment.
+        phoff = ehsize if self._segments else 0
         bodies: list[tuple[int, bytes]] = []
-        pos = ehsize
+        pos = ehsize + len(self._segments) * phentsize
         laid: list[tuple[Section, int, int]] = []  # (sec, offset, size)
         for sec, data in self._sections:
             align = max(1, sec.addralign)
@@ -74,8 +84,15 @@ class ElfWriter:
         out[0:16] = ident
         struct.pack_into(self.end + "HHIQQQIHHHHHH", out, 16,
                          self.e_type, self.e_machine, 1, self.entry,
-                         0, shoff, 0, ehsize, 0, 0, shentsize, n_secs,
+                         phoff, shoff, 0, ehsize,
+                         phentsize if self._segments else 0,
+                         len(self._segments), shentsize, n_secs,
                          shstrndx)
+        for i, seg in enumerate(self._segments):
+            struct.pack_into(self.end + "IIQQQQQQ", out,
+                             phoff + i * phentsize, seg.type, seg.flags,
+                             seg.offset, seg.vaddr, seg.paddr, seg.filesz,
+                             seg.memsz, seg.align)
         for off, data in bodies:
             out[off: off + len(data)] = data
 
@@ -125,6 +142,8 @@ def filter_elf(data: bytes, keep) -> bytes:
     chosen.sort()
 
     w = ElfWriter(ef.e_type, ef.e_machine, ef.entry, ef.end)
+    for seg in ef.segments:
+        w.add_segment(seg)
     new_index = {old: new for new, old in enumerate(chosen, start=1)}
     for i in chosen:
         sec = secs[i]

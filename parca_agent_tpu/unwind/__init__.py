@@ -10,6 +10,7 @@ from parca_agent_tpu.unwind.table import (
     RBP_TYPE_REGISTER,
     RBP_TYPE_UNDEFINED,
     ROW_DTYPE,
+    ShardedTable,
     UnwindTableBuilder,
     build_compact_table,
     identify_expression,
@@ -20,6 +21,6 @@ from parca_agent_tpu.unwind.table import (
 __all__ = [
     "CFA_EXPR_PLT1", "CFA_EXPR_PLT2", "CFA_TYPE_EXPRESSION", "CFA_TYPE_RBP",
     "CFA_TYPE_RSP", "RBP_TYPE_OFFSET", "RBP_TYPE_REGISTER",
-    "RBP_TYPE_UNDEFINED", "ROW_DTYPE", "UnwindTableBuilder",
+    "RBP_TYPE_UNDEFINED", "ROW_DTYPE", "ShardedTable", "UnwindTableBuilder",
     "build_compact_table", "identify_expression", "lookup_rows", "shard_table",
 ]

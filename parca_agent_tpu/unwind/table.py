@@ -202,13 +202,81 @@ class UnwindTableBuilder:
         return sort_rows(np.concatenate(parts))
 
 
-def shard_table(table: np.ndarray) -> list[np.ndarray]:
-    """Range-partition into <=MAX_SHARDS shards of MAX_ROWS_PER_SHARD
-    (maps.go:286-395); tables too large for 3 shards are truncated from the
-    top of the address space, mirroring the reference's hard cap."""
+def shard_table(table: np.ndarray,
+                max_shards: int | None = None) -> list[np.ndarray]:
+    """Range-partition into shards of MAX_ROWS_PER_SHARD rows
+    (maps.go:286-395).
+
+    The reference truncates at 3 shards (750k rows/process) because each
+    shard is one BPF map value with a kernel-verifier-bounded binary
+    search (cpu.bpf.c:35-39); host/device memory has no such bound, so BY
+    DEFAULT every shard is kept and giant processes keep full unwind
+    coverage. Pass max_shards=MAX_SHARDS to reproduce the reference's
+    hard cap (the truncation tests pin that behavior)."""
     shards = [table[i: i + MAX_ROWS_PER_SHARD]
               for i in range(0, len(table), MAX_ROWS_PER_SHARD)]
-    return shards[:MAX_SHARDS]
+    return shards if max_shards is None else shards[:max_shards]
+
+
+class ShardedTable:
+    """Two-level pc lookup over range-partitioned shards — the host twin
+    of the reference's (pid, shard) map layout, where find_unwind_table
+    picks the shard by pc range and find_offset_for_pc binary-searches
+    within it (cpu.bpf.c:380-411 then :302-341).
+
+    Shards are uniform MAX_ROWS_PER_SHARD-row slices (last one ragged),
+    so a global row index maps to (idx // SHARD, idx % SHARD) and callers
+    can gather rows by the indices `lookup` returns.
+    """
+
+    def __init__(self, shards: list[np.ndarray]):
+        if not shards:
+            shards = [np.zeros(0, ROW_DTYPE)]
+        for s in shards[:-1]:
+            if len(s) != MAX_ROWS_PER_SHARD:
+                raise ValueError("interior shards must be full "
+                                 f"({MAX_ROWS_PER_SHARD} rows)")
+        self.shards = shards
+        # First pc per shard; pcs below starts[0] precede the table.
+        self.starts = np.array(
+            [s["pc"][0] if len(s) else np.uint64(0) for s in shards],
+            np.uint64)
+        self.n_rows = int(sum(len(s) for s in shards))
+
+    @classmethod
+    def from_table(cls, table: np.ndarray) -> "ShardedTable":
+        return cls(shard_table(table))
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    def lookup(self, pcs) -> np.ndarray:
+        """Global governing-row index per pc, or -1 (same contract as
+        lookup_rows on the merged table)."""
+        pcs = np.asarray(pcs, np.uint64)
+        si = np.searchsorted(self.starts, pcs, side="right").astype(
+            np.int64) - 1
+        out = np.full(len(pcs), -1, np.int64)
+        for i, shard in enumerate(self.shards):
+            sel = si == i
+            if not sel.any():
+                continue
+            local = lookup_rows(shard, pcs[sel])
+            out[sel] = np.where(
+                local < 0, -1, local + i * MAX_ROWS_PER_SHARD)
+        return out
+
+    def rows(self, idx) -> np.ndarray:
+        """Gather rows by global index (callers pass non-negative idx)."""
+        idx = np.asarray(idx, np.int64)
+        out = np.zeros(len(idx), ROW_DTYPE)
+        si = idx // MAX_ROWS_PER_SHARD
+        local = idx % MAX_ROWS_PER_SHARD
+        for i, shard in enumerate(self.shards):
+            sel = si == i
+            if sel.any():
+                out[sel] = shard[local[sel]]
+        return out
 
 
 def lookup_rows(table: np.ndarray, pcs) -> np.ndarray:

@@ -26,6 +26,7 @@ import dataclasses
 import numpy as np
 
 from parca_agent_tpu.capture.formats import MAX_STACK_DEPTH
+from parca_agent_tpu.dwarf.frame import REG_RBP, REG_RSP
 from parca_agent_tpu.unwind.table import (
     CFA_TYPE_EXPRESSION,
     CFA_TYPE_RBP,
@@ -33,9 +34,25 @@ from parca_agent_tpu.unwind.table import (
     CFA_EXPR_PLT1,
     CFA_EXPR_PLT2,
     RBP_TYPE_OFFSET,
+    RBP_TYPE_REGISTER,
     RBP_TYPE_UNDEFINED,
+    ShardedTable,
     lookup_rows,
 )
+
+
+def _lookup(table, pcs) -> "np.ndarray":
+    """pc -> governing row index on either table form (merged ndarray or
+    ShardedTable two-level)."""
+    if isinstance(table, ShardedTable):
+        return table.lookup(pcs)
+    return lookup_rows(table, pcs)
+
+
+def _rows(table, idx) -> "np.ndarray":
+    if isinstance(table, ShardedTable):
+        return table.rows(idx)
+    return table[idx]
 
 
 @dataclasses.dataclass
@@ -110,7 +127,7 @@ def walk_batch(
         # Lookup pc-1 for return addresses (they point AFTER the call);
         # frame 0 is the sampled rip itself and is looked up as-is.
         lookup_pc = pc if f == 0 else pc - np.uint64(1)
-        idx = lookup_rows(table, np.where(active, lookup_pc, np.uint64(0)))
+        idx = _lookup(table, np.where(active, lookup_pc, np.uint64(0)))
         covered = idx >= 0
         newly_uncov = active & ~covered
         # Stack bottom per the reference (cpu.bpf.c:636-660): success only
@@ -127,7 +144,7 @@ def walk_batch(
         depth[active] = f + 1
 
         safe = np.maximum(idx, 0)
-        row = table[safe]
+        row = _rows(table, safe)
         cfa_t = row["cfa_type"]
         cfa_off = row["cfa_off"].astype(np.int64)
 
@@ -157,11 +174,19 @@ def walk_batch(
         ra_off = (cfa[aidx] - np.uint64(8) - sp0[aidx]).astype(np.int64)
         ra, ok = _read_u64(stacks, dyn, aidx, ra_off)
 
-        # Saved RBP (only the OFFSET rule reads memory; UNDEFINED keeps the
-        # current value, matching cpu.bpf.c:584-621).
+        # Saved RBP. OFFSET reads memory at CFA+off; UNDEFINED keeps the
+        # current value (cpu.bpf.c:584-621); REGISTER takes the named
+        # register's current-frame value — the walker tracks rsp and rbp,
+        # so rules naming those resolve (previous rbp = this frame's
+        # rsp/rbp); other registers aren't tracked and stay unsupported.
+        # The reference bails on ALL register rules (cpu.bpf.c:530-533),
+        # so this is a strict superset of its coverage.
         rbp_t = row["rbp_type"][aidx]
         rbp_off = row["rbp_off"][aidx].astype(np.int64)
         off_rows = rbp_t == RBP_TYPE_OFFSET
+        reg_rows = rbp_t == RBP_TYPE_REGISTER
+        reg_rsp = reg_rows & (rbp_off == REG_RSP)
+        reg_rbp = reg_rows & (rbp_off == REG_RBP)
         new_bp = bp[aidx].copy()
         if off_rows.any():
             sel = aidx[off_rows]
@@ -169,7 +194,10 @@ def walk_batch(
                       - sp0[sel]).astype(np.int64)
             bp_vals, bp_ok = _read_u64(stacks, dyn, sel, bp_off)
             new_bp[off_rows] = np.where(bp_ok, bp_vals, np.uint64(0))
-        keep = off_rows | (rbp_t == RBP_TYPE_UNDEFINED)
+        if reg_rsp.any():
+            new_bp[reg_rsp] = sp[aidx][reg_rsp]
+        # reg_rbp is the identity (new_bp already holds the current rbp).
+        keep = off_rows | reg_rsp | reg_rbp | (rbp_t == RBP_TYPE_UNDEFINED)
 
         # Advance; classify terminations. rbp == 0 does NOT terminate here:
         # the bottom-of-stack test happens at the next iteration's coverage
@@ -188,8 +216,8 @@ def walk_batch(
     # loop's coverage check never ran for their last return address); the
     # rest that died on a bad read are truncated-but-usable prefixes.
     if active.any():
-        idx = lookup_rows(table, np.where(active, pc - np.uint64(1),
-                                          np.uint64(0)))
+        idx = _lookup(table, np.where(active, pc - np.uint64(1),
+                                      np.uint64(0)))
         done_success |= active & (idx < 0) & (bp == 0)
     stats.success = int(done_success.sum())
     stats.pc_not_covered = int((done_notcov & (depth == 0)).sum())

@@ -12,14 +12,21 @@ import time
 import numpy as np
 import pytest
 
+from parca_agent_tpu.dwarf.frame import REG_RBP, REG_RSP
 from parca_agent_tpu.unwind.table import (
     CFA_TYPE_EXPRESSION,
     CFA_TYPE_RBP,
     CFA_TYPE_RSP,
     CFA_EXPR_PLT1,
+    MAX_ROWS_PER_SHARD,
+    MAX_SHARDS,
     RBP_TYPE_OFFSET,
+    RBP_TYPE_REGISTER,
     RBP_TYPE_UNDEFINED,
     ROW_DTYPE,
+    ShardedTable,
+    lookup_rows,
+    shard_table,
     sort_rows,
 )
 from parca_agent_tpu.unwind.walker import walk_batch
@@ -162,6 +169,118 @@ def test_walk_zero_rbp_under_covered_pc_keeps_walking():
     assert stats.success == 1
 
 
+def test_walk_rbp_register_rule_resolves_tracked_registers():
+    """RBP_TYPE_REGISTER naming rsp/rbp continues the walk (the reference
+    bails on every register rule, cpu.bpf.c:530-533 — this is a strict
+    coverage superset). Previous rbp = the named register's current-frame
+    value."""
+    rsp0 = 0x7FFF0000
+    table = _table([
+        # leaf: CFA=rsp+8; previous rbp = this frame's rsp (reg rule).
+        (0x1000, CFA_TYPE_RSP, RBP_TYPE_REGISTER, 8, REG_RSP),
+        # middle: rbp-based CFA proves the register value was adopted:
+        # rbp here == leaf's rsp == rsp0.  CFA = rbp+16 = rsp0+16.
+        (0x2000, CFA_TYPE_RBP, RBP_TYPE_REGISTER, 16, REG_RBP),
+        (0x3000, CFA_TYPE_RSP, RBP_TYPE_UNDEFINED, 8, 0),
+    ])
+    # leaf RA at rsp0 -> 0x2211; middle RA at CFA-8 = rsp0+8 -> 0x3311;
+    # outer RA at CFA-8 = (rsp0+16)+8-8 = rsp0+16 -> 0 stops the walk.
+    mem = _mem(64, **{"0": 0x2211, "8": 0x3311, "16": 0})
+    frames, depth, stats = walk_batch(
+        table,
+        rip=np.array([0x1100], np.uint64),
+        rsp=np.array([rsp0], np.uint64),
+        rbp=np.array([0xDEAD], np.uint64),
+        stacks=mem[None, :],
+        dyn=np.array([64]),
+    )
+    assert depth[0] == 3
+    assert frames[0, :3].tolist() == [0x1100, 0x2211, 0x3311]
+    assert stats.unsupported == 0
+
+
+def test_walk_rbp_register_rule_untracked_register_unsupported():
+    table = _table([
+        (0x1000, CFA_TYPE_RSP, RBP_TYPE_REGISTER, 8, 12),  # r12: untracked
+        (0x2000, CFA_TYPE_RSP, RBP_TYPE_UNDEFINED, 8, 0),
+    ])
+    mem = _mem(32, **{"0": 0x2211})
+    _, depth, stats = walk_batch(
+        table,
+        rip=np.array([0x1100], np.uint64),
+        rsp=np.array([0x100], np.uint64),
+        rbp=np.array([1], np.uint64),
+        stacks=mem[None, :],
+        dyn=np.array([32]),
+    )
+    assert stats.unsupported == 1
+    assert depth[0] == 1  # the frame itself is kept, the walk stops
+
+
+def _big_table(n_rows):
+    t = np.zeros(n_rows, ROW_DTYPE)
+    t["pc"] = (np.arange(n_rows, dtype=np.uint64) + 1) * 16
+    t["cfa_type"] = CFA_TYPE_RSP
+    t["cfa_off"] = 8
+    return t
+
+
+def test_sharded_lookup_matches_merged_beyond_reference_cap():
+    """>750k rows: the reference truncates at 3 shards (maps.go:40-43);
+    here every shard is kept and the two-level lookup agrees with the
+    flat binary search everywhere."""
+    n = MAX_ROWS_PER_SHARD * MAX_SHARDS + 50_000  # 800k rows
+    table = _big_table(n)
+    sharded = ShardedTable.from_table(table)
+    assert len(sharded.shards) == 4  # no truncation
+    assert len(sharded) == n
+    # Reference-cap behavior still reproducible on request:
+    assert len(shard_table(table, max_shards=MAX_SHARDS)) == MAX_SHARDS
+
+    rng = np.random.default_rng(7)
+    pcs = rng.integers(0, (n + 2) * 16, 10_000).astype(np.uint64)
+    np.testing.assert_array_equal(sharded.lookup(pcs),
+                                  lookup_rows(table, pcs))
+    # Coverage past the reference's 750k-row cap actually resolves: a pc
+    # governed by the LAST row (row i covers [pc_i, pc_{i+1}) with
+    # pc_i = (i+1)*16).
+    high_pc = np.uint64(n * 16 + 8)
+    assert sharded.lookup([high_pc])[0] == n - 1
+    # Row gather agrees with direct indexing.
+    idx = sharded.lookup(pcs)
+    ok = idx >= 0
+    np.testing.assert_array_equal(sharded.rows(idx[ok]), table[idx[ok]])
+
+
+def test_walk_on_sharded_table_matches_merged():
+    rsp0 = 0x7FFF0000
+    rows = [
+        (0x1000, CFA_TYPE_RSP, RBP_TYPE_UNDEFINED, 8, 0),
+        (0x2000, CFA_TYPE_RSP, RBP_TYPE_OFFSET, 24, -16),
+        (0x3000, CFA_TYPE_RSP, RBP_TYPE_UNDEFINED, 8, 0),
+    ]
+    table = _table(rows)
+    mem = _mem(64, **{"0": 0x2211, "24": 0x3311, "16": 0x7FFFAA00, "32": 0})
+    args = dict(
+        rip=np.array([0x1100], np.uint64),
+        rsp=np.array([rsp0], np.uint64),
+        rbp=np.array([1], np.uint64),
+        stacks=mem[None, :],
+        dyn=np.array([64]),
+    )
+    f1, d1, s1 = walk_batch(table, **args)
+    f2, d2, s2 = walk_batch(ShardedTable.from_table(table), **args)
+    np.testing.assert_array_equal(f1, f2)
+    np.testing.assert_array_equal(d1, d2)
+    assert dataclasses_eq(s1, s2)
+
+
+def dataclasses_eq(a, b):
+    import dataclasses
+
+    return dataclasses.asdict(a) == dataclasses.asdict(b)
+
+
 def test_unwind_records_clamps_walk_to_kernel_budget():
     """A deep walked user chain plus kernel frames on the record must fit
     MAX_STACK_DEPTH or records_to_snapshot raises and the whole window is
@@ -191,7 +310,7 @@ def test_unwind_records_clamps_walk_to_kernel_budget():
     kframes = np.arange(5, dtype=np.uint64) + np.uint64(0xFFFF800000000000)
     rec = (9, 9, kframes, np.zeros(0, np.uint64),
            0x1100, 0, 1, dump)
-    out = unwind_records([rec], _StubTables(table), min_fp_frames=2)
+    out = unwind_records([rec], _StubTables(table))
     assert len(out[0][3]) == MAX_STACK_DEPTH - len(kframes)  # deep walk
     # The combined record must round-trip into a snapshot without raising.
     snap = records_to_snapshot(out, build_mapping_table({}), int(1e7),
@@ -256,7 +375,7 @@ def test_live_dwarf_capture_recovers_frameless_stacks():
 
         # FP chains of the no-FP binary are shallow; the walker must do
         # materially better on a decent fraction of samples.
-        recs = unwind_records(v2, tables, min_fp_frames=64)
+        recs = unwind_records(v2, tables)
         walked_depths = [len(r[3]) for r in recs]
         fp_depths = [len(r[3]) for r in v2]
         assert max(walked_depths, default=0) >= 4, (
