@@ -229,7 +229,7 @@ class UnwindTableCache:
             return True
         return bool(self._regex.search(self._comm(pid)))
 
-    def table_for(self, pid: int):
+    def table_for(self, pid: int) -> "ShardedTable | None":
         """The pid's table if built; queues a (re)build when missing or
         stale. Never blocks the drain path."""
         now = time.monotonic()
@@ -286,7 +286,7 @@ class UnwindTableCache:
                 with self._lock:
                     self._qset.discard(pid)
 
-    def build_now(self, pid: int):
+    def build_now(self, pid: int) -> "ShardedTable | None":
         """Synchronous build (tests / tools)."""
         from parca_agent_tpu.unwind.table import ShardedTable
 
@@ -372,7 +372,8 @@ class PerfEventSampler:
     def __init__(self, frequency_hz: int = 100, window_s: float = 10.0,
                  drain_cap_mb: int = 64, capture_stack: bool = False,
                  stack_dump_bytes: int = 16 * 1024,
-                 dwarf_comm_regex: str | None = None):
+                 dwarf_comm_regex: str | None = None,
+                 trust_fp_frames: int | None = None):
         self._lib = load_native()
         self._freq = frequency_hz
         self._window = window_s
@@ -399,6 +400,7 @@ class PerfEventSampler:
         self._tables = UnwindTableCache(
             self._maps, comm_regex=dwarf_comm_regex) if capture_stack \
             else None
+        self._trust_fp_frames = trust_fp_frames
         from parca_agent_tpu.unwind.walker import WalkStats
 
         self.walk_stats = WalkStats()
@@ -448,6 +450,7 @@ class PerfEventSampler:
                         self._tables.table_for(pid)
                 records.extend(
                     unwind_records(v2, self._tables,
+                                   trust_fp_frames=self._trust_fp_frames,
                                    stats=self.walk_stats))
             else:
                 records.extend(decode_records(raw))

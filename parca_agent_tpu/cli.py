@@ -64,6 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="only build unwind tables for processes whose comm "
                         "matches (reference --debug-process-names); empty "
                         "= all sampled processes")
+    p.add_argument("--dwarf-trust-fp-frames", type=int, default=0,
+                   help="skip the DWARF walk for samples whose frame-"
+                        "pointer chain already has this many frames "
+                        "(throughput knob; 0 = walk every sample of a "
+                        "targeted process, the reference's behavior)")
     p.add_argument("--dwarf-stack-dump-bytes", type=int, default=16384,
                    help="user-stack bytes snapshotted per sample in DWARF "
                         "mode (multiple of 8, < 64 KiB)")
@@ -175,6 +180,7 @@ def run(argv=None) -> int:
                 capture_stack=args.dwarf_unwinding,
                 stack_dump_bytes=args.dwarf_stack_dump_bytes,
                 dwarf_comm_regex=(args.dwarf_unwinding_comm_regex or None),
+                trust_fp_frames=(args.dwarf_trust_fp_frames or None),
             )
         except SamplerUnavailable as e:
             # Fall back the way the reference degrades when BPF features

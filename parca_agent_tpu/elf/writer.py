@@ -19,7 +19,13 @@ from __future__ import annotations
 
 import struct
 
-from parca_agent_tpu.elf.reader import ElfFile, Section, Segment, SHT_NOBITS
+from parca_agent_tpu.elf.reader import (
+    PT_LOAD,
+    ElfFile,
+    Section,
+    Segment,
+    SHT_NOBITS,
+)
 
 SHT_NULL = 0
 SHT_STRTAB = 3
@@ -142,8 +148,14 @@ def filter_elf(data: bytes, keep) -> bytes:
     chosen.sort()
 
     w = ElfWriter(ef.e_type, ef.e_machine, ef.entry, ef.end)
+    # Only PT_LOAD survives: that is all base computation reads, and any
+    # other segment type (PT_NOTE especially) carries a file offset that
+    # now points at unrelated bytes in the filtered image — a reader's
+    # section-less note fallback would parse garbage from it. Kept note
+    # CONTENT still travels via its sections.
     for seg in ef.segments:
-        w.add_segment(seg)
+        if seg.type == PT_LOAD:
+            w.add_segment(seg)
     new_index = {old: new for new, old in enumerate(chosen, start=1)}
     for i in chosen:
         sec = secs[i]

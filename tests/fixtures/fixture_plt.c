@@ -1,0 +1,32 @@
+/* PLT-heavy fixture: many distinct libc calls so the PLT has many entries
+   and .eh_frame carries the PLT CFA expression over a wide pc range
+   (reference dwarf_expression.go:31-57 recognizes exactly two encodings).
+   Checked in as a prebuilt binary; regenerate with `make fixture_plt`. */
+#include <ctype.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+static int cmp(const void *a, const void *b) {
+  return *(const int *)a - *(const int *)b;
+}
+
+int main(int argc, char **argv) {
+  int n = argc > 1 ? atoi(argv[1]) : 8;
+  int *v = malloc(sizeof(int) * (size_t)n);
+  for (int i = 0; i < n; i++) v[i] = rand() % 100;
+  qsort(v, (size_t)n, sizeof(int), cmp);
+  char buf[128];
+  snprintf(buf, sizeof buf, "%d %s %c", v[0], getenv("HOME") ? "y" : "n",
+           toupper('a'));
+  size_t len = strlen(buf);
+  char *copy = strdup(buf);
+  memmove(copy, buf, len);
+  int r = strcmp(copy, buf) + (int)strtol("42", NULL, 10) +
+          (int)time(NULL) % 2 + atoi(buf) + (int)fwrite(buf, 1, len, stdout);
+  free(copy);
+  free(v);
+  puts("");
+  return r & 1;
+}

@@ -69,8 +69,8 @@ def test_extract_preserves_program_headers_for_base_computation(binary):
 
     src_ef = ElfFile(binary)
     out_ef = ElfFile(extract_debuginfo(binary))
-    assert [tuple(vars(s).values()) for s in out_ef.segments] == \
-        [tuple(vars(s).values()) for s in src_ef.segments]
+    assert out_ef.load_segments() == src_ef.load_segments()
+    assert len(out_ef.load_segments()) == len(out_ef.segments)
     exec_seg = out_ef.exec_load_segment()
     assert exec_seg is not None
     assert exec_seg == src_ef.exec_load_segment()
@@ -80,6 +80,20 @@ def test_extract_preserves_program_headers_for_base_computation(binary):
     assert compute_base(out_ef.e_type, exec_seg, start, limit, offset) == \
         compute_base(src_ef.e_type, src_ef.exec_load_segment(),
                      start, limit, offset)
+
+
+def test_filter_elf_drops_non_load_segments(binary):
+    """Only PT_LOAD survives filtering: a copied PT_NOTE would point its
+    stale file offset at unrelated bytes, and the reader's section-less
+    note fallback would then parse garbage notes from the filtered file."""
+    from parca_agent_tpu.elf.reader import PT_LOAD
+
+    stripped = filter_elf(binary, lambda s: s.name in (".symtab", ".strtab"))
+    ef = ElfFile(stripped)
+    assert ef.segments, "PT_LOAD headers must survive"
+    assert all(s.type == PT_LOAD for s in ef.segments)
+    # No note sections were kept -> no notes, real or phantom.
+    assert list(ef.notes()) == []
 
 
 def test_writer_without_segments_emits_no_phdr_table(binary):

@@ -1,11 +1,16 @@
 """DWARF CFI interpreter + unwind table tests.
 
-Oracle: pyelftools' decoded call-frame tables (test-only dependency) over
-freshly compiled fixture binaries and the host libc — the strongest
-available stand-in for the reference's golden-table fixtures
-(unwind_table_test.go:26-41, BenchmarkParsingLibcDwarfUnwindInformation).
+Primary oracle (environment-independent, the reference's golden-table
+pattern, unwind_table_test.go:26-41 + Makefile:133-137): the CHECKED-IN
+fixture binaries under tests/fixtures/ with golden compact-table dumps
+under tests/fixtures/golden/ — `make -C tests/fixtures golden` regenerates
+them after a deliberate format change. Secondary oracles (optional,
+skipped where unavailable): pyelftools' decoded call-frame tables over
+freshly gcc-compiled binaries and the host libc
+(BenchmarkParsingLibcDwarfUnwindInformation analog).
 """
 
+import os
 import subprocess
 from io import BytesIO
 
@@ -44,6 +49,11 @@ int main(void) { printf("%f\n", f1(42.0)); return 0; }
 """
 
 
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+FIXTURES = ("fixture_nopie", "fixture_pie", "fixture_pie_nofp",
+            "fixture_plt")
+
+
 @pytest.fixture(scope="session")
 def binaries(tmp_path_factory):
     d = tmp_path_factory.mktemp("unwind-fixtures")
@@ -56,10 +66,85 @@ def binaries(tmp_path_factory):
         "pie": ["-O1", "-pie", "-fPIE"],
     }.items():
         path = d / name
-        subprocess.run(["gcc", *flags, str(src), "-o", str(path), "-lm"],
-                       check=True, capture_output=True)
+        try:
+            subprocess.run(["gcc", *flags, str(src), "-o", str(path), "-lm"],
+                           check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            pytest.skip("gcc unavailable; golden-fixture tests still cover "
+                        "the interpreter")
         out[name] = path.read_bytes()
     return out
+
+
+# ---- golden compact tables over checked-in fixtures (primary oracle) ----
+
+
+def _fixture_table(name):
+    with open(os.path.join(FIXDIR, name), "rb") as f:
+        data = f.read()
+    ef = ElfFile(data)
+    sec = ef.section(".eh_frame")
+    return build_compact_table(ef.section_data(sec), sec.addr)
+
+
+@pytest.mark.parametrize("name", FIXTURES)
+def test_golden_compact_tables(name):
+    """Byte-exact table dumps for the checked-in fixtures (the reference's
+    write-dwarf-unwind-tables + git-diff pattern, Makefile:133-137)."""
+    from parca_agent_tpu.tools.eh_frame import format_table
+
+    table = _fixture_table(name)
+    got = f"{len(table)} rows\n" + format_table(table) + "\n"
+    golden_path = os.path.join(FIXDIR, "golden", f"{name}.table.txt")
+    with open(golden_path) as f:
+        want = f.read()
+    assert got == want, (
+        f"{name} compact table drifted from golden; if the change is "
+        f"deliberate run `make -C tests/fixtures golden` and review the diff")
+
+
+def test_golden_tables_have_expected_shape():
+    """Structural pins in the unwind_table_test.go:26-41 style: exact row
+    counts and the known PLT expression coverage."""
+    counts = {name: len(_fixture_table(name)) for name in FIXTURES}
+    assert counts == {"fixture_nopie": 33, "fixture_pie": 33,
+                      "fixture_pie_nofp": 34, "fixture_plt": 26}
+    plt = _fixture_table("fixture_plt")
+    expr = plt[plt["cfa_type"] == CFA_TYPE_EXPRESSION]
+    assert len(expr) == 1  # one FDE's expression row covers the whole .plt
+    assert int(expr["cfa_off"][0]) == CFA_EXPR_PLT1
+    # The expression row governs a wide pc range (many PLT entries).
+    i = int(np.flatnonzero(plt["cfa_type"] == CFA_TYPE_EXPRESSION)[0])
+    span = int(plt["pc"][i + 1]) - int(plt["pc"][i])
+    assert span >= 14 * 16  # >= 14 16-byte PLT slots
+
+
+@pytest.mark.parametrize("name", FIXTURES)
+def test_fixture_rows_match_pyelftools(name):
+    """pyelftools cross-validation over the CHECKED-IN binaries, so the
+    interpreter oracle no longer depends on the ambient gcc/libc."""
+    with open(os.path.join(FIXDIR, name), "rb") as f:
+        data = f.read()
+    eh, addr = _eh(data)
+    ref_rows = _pyelf_rows(data)
+    checked = 0
+    for fde in parse_eh_frame(eh, addr):
+        for row in execute_fde(fde):
+            ref = ref_rows.get(row.loc)
+            if ref is None or row.cfa.type != RuleType.CFA:
+                continue
+            cfa_reg, cfa_off, rbp_off, ra_off = ref
+            assert (row.cfa.reg, row.cfa.offset) == (cfa_reg, cfa_off), \
+                (name, hex(row.loc))
+            if rbp_off is not None:
+                ours = row.rule(REG_RBP)
+                assert ours.type == RuleType.OFFSET and \
+                    ours.offset == rbp_off, (name, hex(row.loc))
+            if ra_off is not None:
+                ra = row.rule(REG_RA)
+                assert ra.type == RuleType.OFFSET and ra.offset == ra_off
+            checked += 1
+    assert checked > 10, f"{name}: too few comparable rows ({checked})"
 
 
 def _eh(data):
