@@ -64,7 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="only build unwind tables for processes whose comm "
                         "matches (reference --debug-process-names); empty "
                         "= all sampled processes")
-    p.add_argument("--dwarf-trust-fp-frames", type=int, default=0,
+    def _non_negative(text: str) -> int:
+        v = int(text)
+        if v < 0:
+            raise argparse.ArgumentTypeError("must be >= 0")
+        return v
+
+    p.add_argument("--dwarf-trust-fp-frames", type=_non_negative, default=0,
                    help="skip the DWARF walk for samples whose frame-"
                         "pointer chain already has this many frames "
                         "(throughput knob; 0 = walk every sample of a "

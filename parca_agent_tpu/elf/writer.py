@@ -6,13 +6,16 @@ subset of an input file's sections — the mechanism behind debuginfo
 extraction ("strip to only what symbolization needs", extract.go:46-123).
 
 Layout produced: ELF header | program headers | section bodies | .shstrtab
-| section header table. Program headers are copied from the source file
-verbatim (vaddr/offset/filesz as originally linked, the eu-strip debug-file
-convention, reference elfwriter.go:64-790 writeSegments role): the
-extracted file is not loadable, but elfexec-style base computation
-(elf/base.py compute_base, pprof GetBase) reads the executable PT_LOAD's
-vaddr and offset from the DEBUG file when the runtime binary is gone, so
-those values must survive extraction unchanged.
+| section header table. Only PT_LOAD program headers are copied from the
+source, verbatim (vaddr/offset/filesz as originally linked, reference
+elfwriter.go:64-790 writeSegments role): the extracted file is not
+loadable, but elfexec-style base computation (elf/base.py compute_base,
+pprof GetBase) reads the executable PT_LOAD's vaddr and offset from the
+DEBUG file when the runtime binary is gone, so those values must survive
+extraction unchanged. Other segment types are dropped — their file
+offsets would point at unrelated bytes in the filtered image (a copied
+PT_NOTE would make section-less note fallbacks parse garbage); kept note
+CONTENT still travels via its sections.
 """
 
 from __future__ import annotations

@@ -17,6 +17,10 @@ from parca_agent_tpu.metadata.providers import Provider
 
 
 class _TTLCache:
+    """Cross-thread safe under the GIL: single dict get/set/pop ops are
+    atomic, and expiry deletion uses pop(…, None) so two threads racing
+    the same expired key (or a get racing purge) cannot KeyError."""
+
     def __init__(self, ttl_s: float, clock):
         self._ttl = ttl_s
         self._clock = clock
@@ -28,7 +32,7 @@ class _TTLCache:
             return None
         t, v = hit
         if self._clock() - t >= self._ttl:
-            del self._d[key]
+            self._d.pop(key, None)
             return None
         return v
 
@@ -37,8 +41,9 @@ class _TTLCache:
 
     def purge(self) -> None:
         now = self._clock()
-        for k in [k for k, (t, _) in self._d.items() if now - t >= self._ttl]:
-            del self._d[k]
+        for k in [k for k, (t, _) in list(self._d.items())
+                  if now - t >= self._ttl]:
+            self._d.pop(k, None)
 
 
 class LabelsManager:

@@ -125,26 +125,7 @@ def test_fixture_rows_match_pyelftools(name):
     interpreter oracle no longer depends on the ambient gcc/libc."""
     with open(os.path.join(FIXDIR, name), "rb") as f:
         data = f.read()
-    eh, addr = _eh(data)
-    ref_rows = _pyelf_rows(data)
-    checked = 0
-    for fde in parse_eh_frame(eh, addr):
-        for row in execute_fde(fde):
-            ref = ref_rows.get(row.loc)
-            if ref is None or row.cfa.type != RuleType.CFA:
-                continue
-            cfa_reg, cfa_off, rbp_off, ra_off = ref
-            assert (row.cfa.reg, row.cfa.offset) == (cfa_reg, cfa_off), \
-                (name, hex(row.loc))
-            if rbp_off is not None:
-                ours = row.rule(REG_RBP)
-                assert ours.type == RuleType.OFFSET and \
-                    ours.offset == rbp_off, (name, hex(row.loc))
-            if ra_off is not None:
-                ra = row.rule(REG_RA)
-                assert ra.type == RuleType.OFFSET and ra.offset == ra_off
-            checked += 1
-    assert checked > 10, f"{name}: too few comparable rows ({checked})"
+    _assert_rows_match(name, data)
 
 
 def _eh(data):
@@ -176,6 +157,7 @@ def test_parse_matches_pyelftools_fde_ranges(binaries):
 
 def _pyelf_rows(data):
     """pyelftools decoded tables: {pc: (cfa_reg, cfa_offset, rbp_off|None)}"""
+    pytest.importorskip("elftools")
     from elftools.dwarf.callframe import RegisterRule
     from elftools.elf.elffile import ELFFile as PyELF
 
@@ -198,28 +180,34 @@ def _pyelf_rows(data):
     return out
 
 
+def _assert_rows_match(name, data, min_checked=10):
+    """Interpreter rows vs pyelftools' decoded tables for one binary."""
+    eh, addr = _eh(data)
+    ref_rows = _pyelf_rows(data)
+    checked = 0
+    for fde in parse_eh_frame(eh, addr):
+        for row in execute_fde(fde):
+            ref = ref_rows.get(row.loc)
+            if ref is None or row.cfa.type != RuleType.CFA:
+                continue
+            cfa_reg, cfa_off, rbp_off, ra_off = ref
+            assert (row.cfa.reg, row.cfa.offset) == (cfa_reg, cfa_off), \
+                (name, hex(row.loc))
+            if rbp_off is not None:
+                ours = row.rule(REG_RBP)
+                assert ours.type == RuleType.OFFSET and \
+                    ours.offset == rbp_off, (name, hex(row.loc))
+            if ra_off is not None:
+                ra = row.rule(REG_RA)
+                assert ra.type == RuleType.OFFSET and ra.offset == ra_off
+            checked += 1
+    assert checked > min_checked, \
+        f"{name}: too few comparable rows ({checked})"
+
+
 def test_rows_match_pyelftools(binaries):
     for name, data in binaries.items():
-        eh, addr = _eh(data)
-        ref_rows = _pyelf_rows(data)
-        checked = 0
-        for fde in parse_eh_frame(eh, addr):
-            for row in execute_fde(fde):
-                ref = ref_rows.get(row.loc)
-                if ref is None or row.cfa.type != RuleType.CFA:
-                    continue
-                cfa_reg, cfa_off, rbp_off, ra_off = ref
-                assert row.cfa.reg == cfa_reg, (name, hex(row.loc))
-                assert row.cfa.offset == cfa_off, (name, hex(row.loc))
-                ours_rbp = row.rule(REG_RBP)
-                if rbp_off is not None:
-                    assert ours_rbp.type == RuleType.OFFSET
-                    assert ours_rbp.offset == rbp_off, (name, hex(row.loc))
-                if ra_off is not None:
-                    ra = row.rule(REG_RA)
-                    assert ra.type == RuleType.OFFSET and ra.offset == ra_off
-                checked += 1
-        assert checked > 10, f"{name}: too few comparable rows ({checked})"
+        _assert_rows_match(name, data)
 
 
 def test_rows_match_pyelftools_libc():

@@ -1,0 +1,239 @@
+"""Thread-safety stress tests (SURVEY.md §5.2: the reference's race/ASAN
+toggles, Makefile:85-97; VERDICT r2 missing #6).
+
+Every component here is shared across agent actor threads in production:
+LabelsManager (profiler writes + config reloader), BatchWriteClient
+(profiler write_raw + flush loop), UnwindTableCache (drain thread + builder
+worker), MatchingProfileListener (query handlers + profiler),
+DictAggregator (profiler feed/close + metrics readers). Each test hammers
+the real cross-thread call pattern and asserts an end-state invariant that
+a lost update, double-free, or mid-mutation read would break. Failures
+here are real bugs, not flakes: the loops are deterministic in total work,
+only the interleaving varies.
+"""
+
+import threading
+import time
+
+N_THREADS = 8
+BARRIER_TIMEOUT = 30
+
+
+def _hammer(n_threads, fn):
+    """Run fn(thread_idx) concurrently; re-raise the first exception."""
+    barrier = threading.Barrier(n_threads, timeout=BARRIER_TIMEOUT)
+    errors = []
+
+    def wrap(i):
+        try:
+            barrier.wait()
+            fn(i)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "stress thread hung"
+    if errors:
+        raise errors[0]
+
+
+def test_labels_manager_concurrent_label_set_and_reconfig():
+    """label_set from many threads racing cache expiry, purge sweeps, and
+    apply_config swaps (profiler threads vs config reloader). The TTL
+    caches must never KeyError on a doubly-deleted expired key."""
+    from parca_agent_tpu.labels.manager import LabelsManager
+    from parca_agent_tpu.labels.relabel import RelabelConfig
+
+    t = [0.0]
+    mgr = LabelsManager([], relabel_configs=[],
+                        profiling_duration_s=0.001,  # tiny TTLs: expiry-heavy
+                        clock=lambda: t[0])
+
+    def work(i):
+        for k in range(3000):
+            t[0] += 0.0005  # advance the shared clock: constant expiry
+            labels = mgr.label_set("cpu", (i * 7 + k) % 41)
+            assert labels["__name__"] == "cpu"
+            if i == 0 and k % 500 == 0:
+                mgr.apply_config([RelabelConfig(
+                    action="replace", source_labels=["pid"],
+                    target_label="slot", replacement="x")])
+
+    _hammer(N_THREADS, work)
+
+
+def test_batch_write_client_no_sample_loss_under_flaky_store():
+    """write_raw from N threads racing the flush loop against a store that
+    fails half its batches: every sample must end up sent exactly once or
+    still buffered (the swap/restore path must not drop or duplicate)."""
+    from parca_agent_tpu.agent.batch import BatchWriteClient
+
+    sent = []
+    fail = [True]
+    lock = threading.Lock()
+
+    class FlakyStore:
+        def write_raw(self, series, normalized):
+            with lock:
+                fail[0] = not fail[0]
+                if fail[0]:
+                    raise ConnectionError("transient")
+                for s in series:
+                    sent.extend(s.samples)
+
+    client = BatchWriteClient(FlakyStore(), interval_s=0.005,
+                              initial_backoff_s=0.001)
+    runner = threading.Thread(target=client.run, daemon=True)
+    runner.start()
+    per_thread = 400
+
+    def work(i):
+        for k in range(per_thread):
+            client.write_raw({"pid": str(k % 17), "t": str(i)},
+                             f"{i}:{k}".encode())
+
+    try:
+        _hammer(N_THREADS, work)
+    finally:
+        client.stop()
+        runner.join(timeout=10)
+    leftover = [smp for s in client._swap() for smp in s.samples]
+    total = len(sent) + len(leftover)
+    assert total == N_THREADS * per_thread
+    assert len(set(sent + leftover)) == total  # no duplicates either
+
+
+def test_unwind_table_cache_concurrent_lookup_and_build(tmp_path):
+    """table_for from N drain threads while the builder worker churns and
+    build_now races it; poison pids must not wedge the worker."""
+    from parca_agent_tpu.capture.live import UnwindTableCache
+    from parca_agent_tpu.process.maps import ProcMapping
+    from parca_agent_tpu.utils.vfs import FakeFS
+
+    with open("tests/fixtures/fixture_pie", "rb") as f:
+        elf = f.read()
+
+    files = {}
+    for pid in range(24):
+        files[f"/proc/{pid}/comm"] = b"stress\n"
+        # Even pids have a real ELF; odd pids a corrupt one (build_errors).
+        files[f"/proc/{pid}/root/bin/app"] = \
+            elf if pid % 2 == 0 else b"\x7fELFgarbage"
+    fs = FakeFS(files)
+
+    class Maps:
+        def executable_mappings(self, pid):
+            seg_off = 0x1000
+            return [ProcMapping(0x1000, 0x5000, "r-xp", seg_off, "08:01",
+                                7, "/bin/app")]
+
+    cache = UnwindTableCache(Maps(), comm_regex="stress", refresh_s=0.01,
+                             fs=fs)
+
+    def work(i):
+        for k in range(300):
+            pid = (i + k) % 24
+            assert cache.matches(pid)
+            t = cache.table_for(pid)  # may be None until built
+            if t is not None and len(t):
+                assert t.lookup([0x1000])[0] >= -1
+            if k % 97 == 0:
+                cache.build_now(pid)
+
+    try:
+        _hammer(N_THREADS, work)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with cache._lock:
+                built = len(cache._built_at)
+            if built == 24 and not cache._queue:
+                break
+            time.sleep(0.02)
+        # Every pid got a build attempt; corrupt ELFs were survived (the
+        # builder returns an empty table for unparseable objects).
+        assert built == 24
+        assert cache.stats["builds"] >= 12
+        for pid in range(0, 24, 2):
+            t = cache.table_for(pid)
+            assert t is not None and len(t) > 0
+    finally:
+        cache.close()
+
+
+def test_matching_profile_listener_waiters_vs_writers():
+    """/query observers registering/timing out concurrently with profile
+    writes must each see exactly one matching profile (or a clean None)."""
+    from parca_agent_tpu.agent.listener import MatchingProfileListener
+
+    class Sink:
+        def write_raw(self, labels, sample):
+            pass
+
+    listener = MatchingProfileListener(next_writer=Sink())
+    got = []
+    glock = threading.Lock()
+    waiters_done = threading.Event()
+
+    def work(i):
+        if i % 2 == 0:  # writers: keep publishing until waiters finish
+            k = 0
+            while not waiters_done.is_set():
+                listener.write_raw({"pid": str(k % 5)}, b"x")
+                k += 1
+        else:  # waiters
+            try:
+                for _ in range(40):
+                    r = listener.next_matching_profile(
+                        lambda lb: lb.get("pid") == "3", timeout=5.0)
+                    with glock:
+                        got.append(r)
+            finally:
+                with glock:
+                    work.done = getattr(work, "done", 0) + 1
+                    if work.done == N_THREADS // 2:
+                        waiters_done.set()
+
+    _hammer(N_THREADS, work)
+    assert len(got) == 40 * (N_THREADS // 2)
+    assert all(r is not None and r[0]["pid"] == "3" for r in got)
+
+
+def test_dict_aggregator_feed_close_vs_readers():
+    """Profiler feeds/closes while metrics threads read stats/timings and
+    query the sketch estimate; close totals must stay exact."""
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+    snap = generate(SyntheticSpec(n_pids=50, n_unique_stacks=4096,
+                                  n_rows=4096, total_samples=100_000,
+                                  seed=3))
+    agg = DictAggregator(capacity=1 << 15, id_cap=1 << 14)
+    hashes = agg.hash_rows(snap)
+    total = int(snap.counts.sum())
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            dict(agg.stats)
+            dict(agg.timings)
+            agg.sketch_estimate(hashes[0][:16])
+
+    readers = [threading.Thread(target=reader, daemon=True)
+               for _ in range(3)]
+    for r in readers:
+        r.start()
+    try:
+        for _ in range(4):
+            for lo in range(0, 4096, 1024):
+                agg.feed(snap, hashes, lo, lo + 1024)
+            counts = agg.close_window()
+            assert int(counts.sum()) == total
+    finally:
+        stop.set()
+        for r in readers:
+            r.join(timeout=5)
