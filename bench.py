@@ -36,15 +36,16 @@ here adds a measured ~70 ms fixed round-trip + ~30 ms/MB to every fetch
 `colocated_est_ms` subtracts the measured fixed tunnel latency only.
 
 Resilience (r2: the TPU tunnel was down at capture time and the bench
-died rc=1 with a bare traceback): the default backend is first probed in
-a FRESH SUBPROCESS with retry/backoff (each attempt its own process
-because jax caches a failed platform init), bounded by
-PARCA_BENCH_INIT_TIMEOUT_S per attempt and PARCA_BENCH_INIT_WAIT_S
-total. If the device never comes up, the same measurement runs on the
-CPU backend (JAX_PLATFORMS=cpu) and the JSON line carries an "error"
-field naming the init failure; if even that fails, a numpy-only CPU
-measurement is printed. The bench always prints its one JSON line and
-exits 0.
+died rc=1 with a bare traceback; r3: backend init through the tunnel
+takes minutes, so paying it twice — probe + main — blew the wall-clock
+budget): the parent process only supervises. The ENTIRE measurement runs
+in a child subprocess (PARCA_BENCH_CHILD=1) so backend init is paid
+exactly once per attempt and a hung init or hung dispatch is bounded by
+the child timeout (PARCA_BENCH_ATTEMPT_TIMEOUT_S). A failed/hung TPU
+child gets one fast retry; then the same measurement runs on the CPU
+backend (JAX_PLATFORMS=cpu) with the JSON line carrying an "error" field
+naming the device failure; if even that fails, a numpy-only measurement
+is printed in-process. The parent always prints ONE JSON line, exit 0.
 
 Prints ONE JSON line:
   {"metric": "steady_window_ms", "value": <close median ms>, "unit": "ms",
@@ -58,8 +59,7 @@ Scale knobs via env:
   PARCA_BENCH_REPS     (default 7)  TPU close reps (median)
   PARCA_BENCH_CPU_REPS (default 5)  CPU rebuild reps (median)
   PARCA_BENCH_BATCH    (default 1)  also bench the one-shot batch kernel
-  PARCA_BENCH_INIT_TIMEOUT_S (default 150) per backend-probe attempt
-  PARCA_BENCH_INIT_WAIT_S    (default 420) total backend-probe budget
+  PARCA_BENCH_ATTEMPT_TIMEOUT_S (default 480) child wall-clock bound
 """
 
 from __future__ import annotations
@@ -73,38 +73,96 @@ import time
 import numpy as np
 
 
+_T0 = time.monotonic()
+
+
+def _progress(msg: str) -> None:
+    """Phase timestamps on stderr (stdout is reserved for the JSON line)."""
+    print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
 def _median_ms(samples: list[float]) -> float:
     return float(np.median(samples) * 1e3)
 
 
-def _probe_backend(attempt_timeout_s: float,
-                   total_wait_s: float) -> str | None:
-    """Bring up the ambient JAX backend in fresh subprocesses, retrying
-    with backoff. Returns None once an attempt succeeds, else the last
-    failure reason. Each attempt is its own process: jax's backends()
-    cache makes an in-process retry unreliable, and r2 showed init can
-    HANG (>4 min), which only a subprocess timeout can bound."""
-    deadline = time.monotonic() + total_wait_s
-    delay = 5.0
-    last = "unprobed"
-    attempt = 0
-    while True:
-        attempt += 1
+def _run_child(timeout_s: float, extra_env: dict | None = None
+               ) -> dict | str:
+    """One measurement attempt in a fresh subprocess (its own backend
+    init, hang-bounded). Returns the parsed result dict, or a failure
+    description string."""
+    env = dict(os.environ, PARCA_BENCH_CHILD="1", **(extra_env or {}))
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired as e:
+        partial = e.stderr or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        sys.stderr.write(partial)  # show how far the child got
+        tail = partial.strip().splitlines()
+        last = tail[-1][-200:] if tail else "no progress output"
+        return f"attempt hung >{timeout_s:.0f}s (last: {last})"
+    # Child progress (stderr) passes through for the log.
+    sys.stderr.write(r.stderr)
+    if r.returncode != 0:
+        tail = (r.stderr.strip() or "no output").splitlines()
+        return f"rc={r.returncode}: {tail[-1][-400:]}"
+    for line in reversed(r.stdout.strip().splitlines()):
         try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, text=True, timeout=attempt_timeout_s)
-            if r.returncode == 0:
-                return None
-            tail = (r.stderr.strip() or r.stdout.strip()).splitlines()
-            last = tail[-1][-400:] if tail else f"rc={r.returncode}"
-        except subprocess.TimeoutExpired:
-            last = f"backend init hung >{attempt_timeout_s:.0f}s"
-        if time.monotonic() + delay >= deadline:
-            return f"after {attempt} attempts: {last}"
-        time.sleep(delay)
-        delay = min(delay * 2, 60.0)
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):  # ignore stray scalar stdout lines
+            return parsed
+    return "child printed no JSON result line"
+
+
+def _bench_spec(rows: int, pids: int):
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec
+
+    return SyntheticSpec(
+        n_pids=pids,
+        n_unique_stacks=rows,
+        n_rows=rows,
+        total_samples=max(5_000_000, rows + 1),
+        mean_depth=24,
+        kernel_fraction=0.2,
+        seed=42,
+    )
+
+
+def _make_snapshot(rows: int, pids: int):
+    """Generate (or load the parent-cached copy of) the synthetic window.
+    Generation costs ~75s at 1M rows; the parent pre-generates once so
+    retry/fallback children don't re-pay it. The cache name fingerprints
+    the full spec so a spec/seed change can't serve a stale file."""
+    import hashlib
+    import tempfile
+
+    from parca_agent_tpu.capture.formats import load_snapshot, save_snapshot
+    from parca_agent_tpu.capture.synthetic import generate
+
+    spec = _bench_spec(rows, pids)
+    tag = hashlib.sha1(repr(spec).encode()).hexdigest()[:12]
+    path = os.path.join(tempfile.gettempdir(), f"parca_bench_snap_{tag}.bin")
+    if os.path.exists(path):
+        try:
+            snap = load_snapshot(path)
+            _progress(f"loaded cached snapshot {path}")
+            return snap
+        except Exception:  # noqa: BLE001 - regenerate on a corrupt cache
+            pass
+    _progress("generating synthetic window")
+    snap = generate(spec)
+    try:
+        tmp = path + f".tmp{os.getpid()}"
+        save_snapshot(snap, tmp)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+    return snap
 
 
 def run(extras: dict) -> dict:
@@ -116,22 +174,30 @@ def run(extras: dict) -> dict:
 
     import jax
 
+    # Persistent compilation cache: first-compile through the dev tunnel
+    # costs ~20-40s per program; retry/fallback children (and later bench
+    # runs on this host) reuse the compiled binaries. Per-platform dirs:
+    # XLA:CPU AOT artifacts are machine-feature-sensitive and must not be
+    # served to a differently-flagged backend (cpu_aot_loader SIGILL
+    # warnings observed when the dirs were shared).
+    try:
+        plat = os.environ.get("JAX_PLATFORMS", "device") or "device"
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("PARCA_BENCH_JAX_CACHE",
+                           f"/tmp/parca_jax_cache_{plat}"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
+
+    _progress(f"jax up, backend={jax.default_backend()}")
+
     from parca_agent_tpu.aggregator.cpu import window_counts_rebuild
     from parca_agent_tpu.aggregator.dict import DictAggregator
-    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
 
-    snap = generate(
-        SyntheticSpec(
-            n_pids=pids,
-            n_unique_stacks=rows,
-            n_rows=rows,
-            total_samples=max(5_000_000, rows + 1),
-            mean_depth=24,
-            kernel_fraction=0.2,
-            seed=42,
-        )
-    )
+    snap = _make_snapshot(rows, pids)
 
+    _progress(f"snapshot ready: {rows} rows, {pids} pids")
     # Measure the tunnel's fixed round-trip (tiny compute + tiny fetch).
     tiny = jax.jit(lambda a: a + 1)
     x = jax.device_put(np.zeros(8, np.int32))
@@ -145,15 +211,18 @@ def run(extras: dict) -> dict:
 
     # Table sized 4x the expected population: load factor ~0.25 keeps probe
     # chains within the device bound, id headroom 2x.
+    _progress(f"tunnel rtt {tunnel_rtt_ms:.1f} ms")
     cap = 1 << max(16, (4 * rows - 1).bit_length())
     agg = DictAggregator(capacity=cap, id_cap=cap // 2)
     hashes = agg.hash_rows(snap)
     # First window: compiles the programs and inserts the stack population
     # (one-time, capture-side-amortized in production).
+    _progress("first window (compile + insert population)")
     counts = agg.window_counts(snap, hashes)
     total = int(counts.sum())
     assert total == snap.total_samples()
 
+    _progress("first window done")
     chunk = 1 << 17  # one capture drain's worth of rows per feed
     # Warm both close widths (first close predicts from no history).
     for _ in range(2):
@@ -161,6 +230,7 @@ def run(extras: dict) -> dict:
             agg.feed(snap, hashes, lo, min(lo + chunk, rows))
         assert int(agg.close_window().sum()) == total
 
+    _progress("warmup done; measuring steady-state")
     feed_times, close_times = [], []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -174,12 +244,14 @@ def run(extras: dict) -> dict:
     tpu_ms = _median_ms(close_times)
     phases = {k: round(v * 1e3, 2) for k, v in agg.timings.items()}
 
+    _progress(f"steady-state done: close median {tpu_ms:.1f} ms")
     # Fully-synchronous one-shot boundary, for reference.
     t0 = time.perf_counter()
     counts = agg.window_counts(snap, hashes)
     sync_ms = (time.perf_counter() - t0) * 1e3
     assert int(counts.sum()) == total
 
+    _progress(f"sync one-shot done: {sync_ms:.1f} ms")
     cpu_times = []
     for _ in range(cpu_reps):
         t0 = time.perf_counter()
@@ -188,6 +260,7 @@ def run(extras: dict) -> dict:
     cpu_ms = _median_ms(cpu_times)
     assert int(cpu_counts.sum()) == total
 
+    _progress(f"cpu rebuild done: {cpu_ms:.1f} ms")
     # Exact-vs-count-min A/B at the full unique-stack scale (BASELINE
     # config #4): the sketch is the bounded-memory degradation mode
     # (DictAggregator overflow="sketch"); publish its error envelope
@@ -222,6 +295,7 @@ def run(extras: dict) -> dict:
         except Exception as e:  # noqa: BLE001 - report, don't fail the bench
             extras["ab_sketch_error"] = repr(e)[:120]
 
+    _progress("A/B sketch done")
     if bench_batch:
         try:
             import jax.numpy as jnp
@@ -274,14 +348,10 @@ def _last_resort(err: str) -> dict:
     """jax unusable entirely: still print a real number (the numpy CPU
     rebuild needs no jax) so the artifact is never a bare traceback."""
     from parca_agent_tpu.aggregator.cpu import window_counts_rebuild
-    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
 
     rows = int(os.environ.get("PARCA_BENCH_ROWS", 1 << 20))
     pids = int(os.environ.get("PARCA_BENCH_PIDS", 50_000))
-    snap = generate(SyntheticSpec(
-        n_pids=pids, n_unique_stacks=rows, n_rows=rows,
-        total_samples=max(5_000_000, rows + 1), mean_depth=24,
-        kernel_fraction=0.2, seed=42))
+    snap = _make_snapshot(rows, pids)  # loads the parent-cached copy
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -302,30 +372,92 @@ def _last_resort(err: str) -> dict:
     }
 
 
+def _child_main() -> None:
+    """The measurement process: no supervision, just run and print."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # The ambient sitecustomize registers the TPU backend and forces
+        # jax_platforms to it, overriding the env var (see
+        # tests/conftest.py) — the cpu-fallback child must override the
+        # live config back.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    result = run({})
+    print(json.dumps(result))
+
+
 def main() -> None:
-    attempt_timeout = float(os.environ.get("PARCA_BENCH_INIT_TIMEOUT_S", 150))
-    total_wait = float(os.environ.get("PARCA_BENCH_INIT_WAIT_S", 420))
+    if os.environ.get("PARCA_BENCH_CHILD"):
+        _child_main()
+        return
 
-    extras: dict = {}
-    # Tests / CI pin JAX_PLATFORMS=cpu already; no point probing a device.
-    if os.environ.get("JAX_PLATFORMS", "") not in ("cpu",):
-        probe_err = _probe_backend(attempt_timeout, total_wait)
-        if probe_err is not None:
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            extras["error"] = (
-                "device backend init failed, cpu-backend fallback: "
-                + probe_err)
+    timeout_s = float(os.environ.get("PARCA_BENCH_ATTEMPT_TIMEOUT_S", 480))
+    errors: list[str] = []
+    result: dict | None = None
 
+    rows = int(os.environ.get("PARCA_BENCH_ROWS", 1 << 20))
+    pids = int(os.environ.get("PARCA_BENCH_PIDS", 50_000))
+
+    # An ambient cpu pin (tests/CI) means the "device" IS the XLA CPU
+    # backend, which runs the dict kernels far slower than a TPU — use
+    # the reduced scale there from the start or the attempt would blow
+    # its budget (same reasoning as the fallback below).
+    ambient_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    reduced = {
+        "PARCA_BENCH_ROWS": str(min(rows, 1 << 17)),
+        "PARCA_BENCH_PIDS": str(min(pids, 10_000)),
+        "PARCA_BENCH_REPS": "3",
+        "PARCA_BENCH_BATCH": "0",
+    }
+
+    # Pre-generate the synthetic window the first attempt will use
+    # (numpy-only, no backend needed) so every child attempt loads it in
+    # seconds instead of ~75s each.
     try:
-        result = run(extras)
-    except Exception as e:  # noqa: BLE001 - the JSON line must still print
+        if ambient_cpu:
+            _make_snapshot(int(reduced["PARCA_BENCH_ROWS"]),
+                           int(reduced["PARCA_BENCH_PIDS"]))
+        else:
+            _make_snapshot(rows, pids)
+    except Exception as e:  # noqa: BLE001 - children can still generate
+        _progress(f"snapshot pre-generation failed (non-fatal): {e!r}")
+
+    # Attempt 1 (+ one retry on FAST failure — a hang means the backend
+    # is wedged and retrying would double the worst case) on the ambient
+    # backend.
+    for attempt in (1, 2):
+        t0 = time.monotonic()
+        _progress(f"device attempt {attempt} (timeout {timeout_s:.0f}s)")
+        got = _run_child(timeout_s, reduced if ambient_cpu else None)
+        if isinstance(got, dict):
+            result = got
+            break
+        errors.append(got)
+        _progress(f"device attempt {attempt} failed: {got}")
+        if time.monotonic() - t0 > timeout_s / 4:
+            break  # slow failure/hang: don't retry
+
+    # CPU-backend fallback: same measurement at reduced scale, JSON
+    # carries the error. (Skipped when the primary attempts already ran
+    # on the cpu pin.)
+    if result is None and not ambient_cpu:
+        _progress("falling back to JAX_PLATFORMS=cpu at reduced scale")
+        got = _run_child(timeout_s, {"JAX_PLATFORMS": "cpu", **reduced})
+        if isinstance(got, dict):
+            got["error"] = ("device attempts failed, cpu-backend fallback "
+                            "at reduced scale: " + " | ".join(errors))[:500]
+            result = got
+        else:
+            errors.append(got)
+
+    if result is None:
         try:
-            result = _last_resort(
-                extras.get("error", "") + f" | bench run failed: {e!r}")
-        except Exception as e2:  # noqa: BLE001
+            result = _last_resort(" | ".join(errors))
+        except Exception as e2:  # noqa: BLE001 - the line must still print
             result = {"metric": "steady_window_ms", "value": None,
                       "unit": "ms", "vs_baseline": None,
-                      "error": f"{e!r} | last-resort failed: {e2!r}"[:500]}
+                      "error": (" | ".join(errors)
+                                + f" | last-resort failed: {e2!r}")[:500]}
     print(json.dumps(result))
 
 
