@@ -86,37 +86,45 @@ def _median_ms(samples: list[float]) -> float:
     return float(np.median(samples) * 1e3)
 
 
-def _run_child(timeout_s: float, extra_env: dict | None = None
-               ) -> dict | str:
-    """One measurement attempt in a fresh subprocess (its own backend
-    init, hang-bounded). Returns the parsed result dict, or a failure
-    description string."""
-    env = dict(os.environ, PARCA_BENCH_CHILD="1", **(extra_env or {}))
-    try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           capture_output=True, text=True,
-                           timeout=timeout_s, env=env)
-    except subprocess.TimeoutExpired as e:
-        partial = e.stderr or b""
-        if isinstance(partial, bytes):
-            partial = partial.decode(errors="replace")
-        sys.stderr.write(partial)  # show how far the child got
-        tail = partial.strip().splitlines()
-        last = tail[-1][-200:] if tail else "no progress output"
-        return f"attempt hung >{timeout_s:.0f}s (last: {last})"
-    # Child progress (stderr) passes through for the log.
-    sys.stderr.write(r.stderr)
-    if r.returncode != 0:
-        tail = (r.stderr.strip() or "no output").splitlines()
-        return f"rc={r.returncode}: {tail[-1][-400:]}"
-    for line in reversed(r.stdout.strip().splitlines()):
+def _scan_json_line(stdout: str) -> dict | None:
+    for line in reversed(stdout.strip().splitlines()):
         try:
             parsed = json.loads(line)
         except json.JSONDecodeError:
             continue
         if isinstance(parsed, dict):  # ignore stray scalar stdout lines
             return parsed
-    return "child printed no JSON result line"
+    return None
+
+
+def _run_child(timeout_s: float, extra_env: dict | None = None
+               ) -> dict | str:
+    """One measurement attempt in a fresh subprocess (its own backend
+    init, hang-bounded). Returns the parsed result dict, or a failure
+    description string. A measurement that PRINTED its result and then
+    hung/crashed in backend teardown (the tunnel's specialty) still
+    counts: the JSON scan runs on whatever stdout was captured."""
+
+    def _text(v) -> str:
+        return v.decode(errors="replace") if isinstance(v, bytes) else v or ""
+
+    env = dict(os.environ, PARCA_BENCH_CHILD="1", **(extra_env or {}))
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+        stdout, stderr = r.stdout, r.stderr
+        fail = f"rc={r.returncode}" if r.returncode != 0 else None
+    except subprocess.TimeoutExpired as e:
+        stdout, stderr = _text(e.stdout), _text(e.stderr)
+        fail = f"attempt hung >{timeout_s:.0f}s"
+    sys.stderr.write(stderr)  # child progress passes through for the log
+    got = _scan_json_line(stdout)
+    if got is not None:
+        return got
+    tail = (stderr.strip() or "no output").splitlines()
+    last = tail[-1][-300:] if tail else "no output"
+    return f"{fail or 'no JSON result line'}: {last}"
 
 
 def _bench_spec(rows: int, pids: int):
@@ -133,20 +141,24 @@ def _bench_spec(rows: int, pids: int):
     )
 
 
-def _make_snapshot(rows: int, pids: int):
-    """Generate (or load the parent-cached copy of) the synthetic window.
-    Generation costs ~75s at 1M rows; the parent pre-generates once so
-    retry/fallback children don't re-pay it. The cache name fingerprints
-    the full spec so a spec/seed change can't serve a stale file."""
+def _snapshot_path(rows: int, pids: int) -> str:
+    """Cache file for a spec; the name fingerprints the FULL spec so a
+    spec/seed change can't serve a stale file."""
     import hashlib
     import tempfile
 
+    tag = hashlib.sha1(repr(_bench_spec(rows, pids)).encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(), f"parca_bench_snap_{tag}.bin")
+
+
+def _make_snapshot(rows: int, pids: int):
+    """Generate (or load the parent-cached copy of) the synthetic window.
+    Generation costs ~75s at 1M rows; the parent pre-generates once so
+    retry/fallback children don't re-pay it."""
     from parca_agent_tpu.capture.formats import load_snapshot, save_snapshot
     from parca_agent_tpu.capture.synthetic import generate
 
-    spec = _bench_spec(rows, pids)
-    tag = hashlib.sha1(repr(spec).encode()).hexdigest()[:12]
-    path = os.path.join(tempfile.gettempdir(), f"parca_bench_snap_{tag}.bin")
+    path = _snapshot_path(rows, pids)
     if os.path.exists(path):
         try:
             snap = load_snapshot(path)
@@ -155,17 +167,21 @@ def _make_snapshot(rows: int, pids: int):
         except Exception:  # noqa: BLE001 - regenerate on a corrupt cache
             pass
     _progress("generating synthetic window")
-    snap = generate(spec)
+    snap = generate(_bench_spec(rows, pids))
     try:
         tmp = path + f".tmp{os.getpid()}"
         save_snapshot(snap, tmp)
         os.replace(tmp, path)
-    except OSError:
-        pass
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
     return snap
 
 
-def run(extras: dict) -> dict:
+def run() -> dict:
+    extras: dict = {}
     rows = int(os.environ.get("PARCA_BENCH_ROWS", 1 << 20))
     pids = int(os.environ.get("PARCA_BENCH_PIDS", 50_000))
     reps = int(os.environ.get("PARCA_BENCH_REPS", 7))
@@ -344,13 +360,12 @@ def run(extras: dict) -> dict:
     }
 
 
-def _last_resort(err: str) -> dict:
+def _last_resort(err: str, rows: int, pids: int) -> dict:
     """jax unusable entirely: still print a real number (the numpy CPU
-    rebuild needs no jax) so the artifact is never a bare traceback."""
+    rebuild needs no jax) so the artifact is never a bare traceback. The
+    caller passes the scale it pre-generated, so this loads from cache."""
     from parca_agent_tpu.aggregator.cpu import window_counts_rebuild
 
-    rows = int(os.environ.get("PARCA_BENCH_ROWS", 1 << 20))
-    pids = int(os.environ.get("PARCA_BENCH_PIDS", 50_000))
     snap = _make_snapshot(rows, pids)  # loads the parent-cached copy
     times = []
     for _ in range(3):
@@ -382,7 +397,7 @@ def _child_main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    result = run({})
+    result = run()
     print(json.dumps(result))
 
 
@@ -410,15 +425,29 @@ def main() -> None:
         "PARCA_BENCH_BATCH": "0",
     }
 
-    # Pre-generate the synthetic window the first attempt will use
-    # (numpy-only, no backend needed) so every child attempt loads it in
-    # seconds instead of ~75s each.
+    # Pre-generate BOTH scales (numpy-only, no backend needed) so every
+    # child — primary, retry, reduced-scale fallback, and the in-process
+    # last resort — loads its window in seconds instead of generating.
+    # Prune stale cache tags first so /tmp doesn't accumulate one file
+    # per historical spec.
+    r_rows = int(reduced["PARCA_BENCH_ROWS"])
+    r_pids = int(reduced["PARCA_BENCH_PIDS"])
+    keep = {os.path.basename(_snapshot_path(rows, pids)),
+            os.path.basename(_snapshot_path(r_rows, r_pids))}
+    import tempfile
+
+    tmpdir = tempfile.gettempdir()
     try:
-        if ambient_cpu:
-            _make_snapshot(int(reduced["PARCA_BENCH_ROWS"]),
-                           int(reduced["PARCA_BENCH_PIDS"]))
-        else:
+        for name in os.listdir(tmpdir):
+            if name.startswith("parca_bench_snap_") and name not in keep:
+                os.unlink(os.path.join(tmpdir, name))
+    except OSError:
+        pass
+    try:
+        if not ambient_cpu:
             _make_snapshot(rows, pids)
+        if (r_rows, r_pids) != (rows, pids) or ambient_cpu:
+            _make_snapshot(r_rows, r_pids)
     except Exception as e:  # noqa: BLE001 - children can still generate
         _progress(f"snapshot pre-generation failed (non-fatal): {e!r}")
 
@@ -452,7 +481,9 @@ def main() -> None:
 
     if result is None:
         try:
-            result = _last_resort(" | ".join(errors))
+            result = _last_resort(" | ".join(errors),
+                                  *((r_rows, r_pids) if ambient_cpu
+                                    else (rows, pids)))
         except Exception as e2:  # noqa: BLE001 - the line must still print
             result = {"metric": "steady_window_ms", "value": None,
                       "unit": "ms", "vs_baseline": None,

@@ -1,0 +1,113 @@
+"""Full-agent remote-write e2e: the CLI shell in replay mode shipping to
+an in-process Parca-style gRPC store.
+
+The reference's e2e asserts that after the agent runs, the store can
+query non-empty series (e2e/e2e_test.go:70-141 against minikube); here
+the store is an in-process gRPC server and the assertion decodes the
+WriteRaw requests it received: valid gzipped pprofs, correct label sets,
+relabeling applied — the same observable boundary without a cluster.
+"""
+
+import gzip
+import threading
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.capture.formats import (
+    MappingTable,
+    WindowSnapshot,
+    save_snapshot,
+)
+
+
+def _snap(n_pids=3):
+    pids = np.repeat(np.arange(1, n_pids + 1, dtype=np.int32), 2)
+    n = len(pids)
+    stacks = np.zeros((n, 128), np.uint64)
+    stacks[:, 0] = 0x1000 + np.arange(n, dtype=np.uint64) * 16
+    stacks[:, 1] = 0x2000
+    return WindowSnapshot(
+        pids=pids,
+        tids=pids.copy(),
+        counts=np.full(n, 3, np.int64),
+        user_len=np.full(n, 2, np.int32),
+        kernel_len=np.zeros(n, np.int32),
+        stacks=stacks,
+        mappings=MappingTable.empty(),
+        period_ns=10_000_000,
+        window_ns=10_000_000_000,
+    )
+
+
+def test_agent_ships_profiles_to_grpc_store(tmp_path):
+    grpc = pytest.importorskip("grpc")
+    from concurrent import futures
+
+    from parca_agent_tpu.agent.grpc_client import WRITE_RAW_METHOD
+    from parca_agent_tpu.agent.profilestore import decode_write_raw_request
+    from parca_agent_tpu.cli import run
+    from parca_agent_tpu.pprof.builder import parse_pprof
+
+    received = []
+    got_any = threading.Event()
+
+    def handler(request, context):
+        series, normalized = decode_write_raw_request(request)
+        received.append((series, normalized))
+        got_any.set()
+        return b""
+
+    svc, method = WRITE_RAW_METHOD.lstrip("/").rsplit("/", 1)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+        svc,
+        {method: grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )},
+    ),))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+
+    snap_path = tmp_path / "w.snap"
+    save_snapshot(_snap(), str(snap_path))
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "relabel_configs:\n- action: labeldrop\n  regex: kernel_release\n")
+
+    try:
+        rc = run([
+            "--capture", "replay", "--replay", str(snap_path),
+            "--remote-store-address", f"127.0.0.1:{port}",
+            "--remote-store-insecure",
+            # Short batch interval so the flush happens before shutdown.
+            "--remote-store-batch-write-interval", "0.2",
+            "--config-path", str(cfg),
+            "--http-address", "127.0.0.1:0",
+            "--windows", "1",
+            "--debuginfo-upload-disable",
+            "--node", "e2e-node",
+            "--metadata-external-labels", "env=e2e",
+        ])
+        assert rc == 0
+        assert got_any.wait(10), "store never received a WriteRaw"
+    finally:
+        server.stop(0)
+
+    all_series = [s for series, _ in received for s in series]
+    assert all(normalized for _, normalized in received)
+    # One series per pid, each with the full label pipeline applied.
+    by_pid = {s.labels["pid"]: s for s in all_series}
+    assert set(by_pid) == {"1", "2", "3"}
+    for s in all_series:
+        assert s.labels["__name__"] == "parca_agent_cpu"
+        assert s.labels["node"] == "e2e-node"
+        assert s.labels["env"] == "e2e"
+        assert "kernel_release" not in s.labels  # relabeling applied
+        for sample in s.samples:
+            prof = parse_pprof(gzip.decompress(sample))
+            assert prof.samples
+            # 2 stacks/pid x 3 counts each.
+            assert sum(v[0] for _, v, _ in prof.samples) == 6
