@@ -123,12 +123,58 @@ class ElfWriter:
         return bytes(out)
 
 
-def filter_elf(data: bytes, keep) -> bytes:
-    """Copy an ELF keeping predicate-matched sections (the FilteringWriter
-    role, filtering_elfwriter.go:26-196). Sections a kept section `link`s to
-    (e.g. .symtab -> .strtab) are pulled in automatically and link indices
-    remapped."""
-    ef = ElfFile(data)
+def compose_elf(parts: list[tuple[bytes, "callable"]]) -> bytes:
+    """Compose ONE ELF from sections of several source files (the
+    reference's AggregatingWriter role, aggregating_elfwriter.go:27-76).
+
+    The FIRST part is the primary: it contributes the file identity
+    (header fields, PT_LOAD program headers) as well as its
+    predicate-matched sections. Each later (data, keep) part contributes
+    its matching sections; same-named sections from later parts are
+    skipped (first wins), so e.g. a separate debug file's .debug_* can
+    be merged under the runtime binary's .note.gnu.build-id without
+    duplicating tables. Linked sections (.symtab -> .strtab) are pulled
+    per-part and link indices remapped into the combined table; when the
+    dedup drops a later part's link target, the link resolves by NAME to
+    the earlier part's section — callers composing same-named tables
+    from DIFFERENT builds must ensure the winning table is the right one
+    (same caller contract as the reference's AggregatingWriter).
+    """
+    import dataclasses as _dc
+
+    w: ElfWriter | None = None
+    seen: dict[str, int] = {}  # name -> combined table index (1-based)
+    for data, keep in parts:
+        ef = ElfFile(data)
+        if w is None:
+            w = ElfWriter(ef.e_type, ef.e_machine, ef.entry, ef.end)
+            for seg in ef.segments:
+                if seg.type == PT_LOAD:
+                    w.add_segment(seg)
+        chosen = _select_sections(ef, keep)
+        # Drop names an earlier part already contributed (first wins).
+        kept = [i for i in chosen if ef.sections[i].name not in seen]
+        base = len(w._sections)
+        new_index = {old: base + new
+                     for new, old in enumerate(kept, start=1)}
+        for i in kept:
+            sec = ef.sections[i]
+            # A link target dropped by the dedup resolves BY NAME to the
+            # earlier part's section (e.g. part 2's .symtab links part
+            # 1's .strtab) so no surviving section dangles at link=0.
+            link = new_index.get(sec.link, 0)
+            if link == 0 and sec.link:
+                link = seen.get(ef.sections[sec.link].name, 0)
+            seen[sec.name] = new_index[i]
+            w.add_section(_dc.replace(sec, link=link), ef.section_data(sec))
+    if w is None:
+        raise ValueError("compose_elf needs at least one part")
+    return w.serialize()
+
+
+def _select_sections(ef: ElfFile, keep) -> list[int]:
+    """Predicate-matched section indices plus their link closure
+    (shared by filter_elf and compose_elf)."""
     secs = ef.sections
     chosen: list[int] = []
     for i, sec in enumerate(secs):
@@ -138,7 +184,6 @@ def filter_elf(data: bytes, keep) -> bytes:
             continue  # writer regenerates it
         if keep(sec):
             chosen.append(i)
-    # Pull linked sections (string/symbol tables).
     pulled = True
     while pulled:
         pulled = False
@@ -149,6 +194,17 @@ def filter_elf(data: bytes, keep) -> bytes:
                 chosen.append(link)
                 pulled = True
     chosen.sort()
+    return chosen
+
+
+def filter_elf(data: bytes, keep) -> bytes:
+    """Copy an ELF keeping predicate-matched sections (the FilteringWriter
+    role, filtering_elfwriter.go:26-196). Sections a kept section `link`s to
+    (e.g. .symtab -> .strtab) are pulled in automatically and link indices
+    remapped."""
+    ef = ElfFile(data)
+    secs = ef.sections
+    chosen = _select_sections(ef, keep)
 
     w = ElfWriter(ef.e_type, ef.e_machine, ef.entry, ef.end)
     # Only PT_LOAD survives: that is all base computation reads, and any

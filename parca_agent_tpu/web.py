@@ -133,8 +133,33 @@ class AgentHTTPServer:
                         b"self-profile endpoints:\n"
                         b"  /debug/pprof/profile?seconds=N  "
                         b"sampling wall-clock profile of the agent\n"
+                        b"  /debug/pprof/heap?seconds=N     "
+                        b"tracemalloc heap profile over a bounded "
+                        b"N-second tracing window\n"
                         b"  /debug/pprof/cmdline            "
                         b"agent command line\n"))
+                elif name == "heap":
+                    from parca_agent_tpu.profiler.selfprofile import (
+                        heap_self,
+                    )
+
+                    try:
+                        seconds = float(params.get("seconds", "5"))
+                    except ValueError:
+                        self._send(400, b"bad seconds parameter\n")
+                        return
+                    if not 0 < seconds <= 300:
+                        self._send(400, b"seconds must be in (0, 300]\n")
+                        return
+                    body = heap_self(seconds)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Disposition",
+                                     'attachment; filename="heap.pb.gz"')
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif name == "cmdline":
                     import sys as _sys
 

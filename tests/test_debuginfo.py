@@ -82,6 +82,66 @@ def test_extract_preserves_program_headers_for_base_computation(binary):
                      start, limit, offset)
 
 
+def test_compose_elf_merges_debug_under_runtime_identity(binary):
+    """AggregatingWriter role (reference aggregating_elfwriter.go:27-76):
+    one ELF from the runtime binary's identity (notes, PT_LOAD) plus a
+    separate debug file's DWARF + symbols."""
+    from parca_agent_tpu.elf.base import compute_base
+    from parca_agent_tpu.elf.writer import compose_elf
+
+    debug = extract_debuginfo(binary)
+    out = compose_elf([
+        (binary, lambda s: s.name.startswith(".note.")),
+        (debug, lambda s: s.name.startswith((".debug_", ".symtab"))),
+    ])
+    ef = ElfFile(out)
+    names = [s.name for s in ef.sections]
+    # Identity from the runtime file...
+    assert gnu_build_id(ef) == gnu_build_id(ElfFile(binary))
+    src = ElfFile(binary)
+    assert ef.exec_load_segment() == src.exec_load_segment()
+    assert compute_base(ef.e_type, ef.exec_load_segment(),
+                        0x7f0000000000, 0x7f0000400000, 0) == \
+        compute_base(src.e_type, src.exec_load_segment(),
+                     0x7f0000000000, 0x7f0000400000, 0)
+    # ...payload from the debug file, link closure intact.
+    assert any(n.startswith(".debug_") for n in names)
+    assert ".strtab" in names  # pulled via .symtab link
+    assert {s.name for s in ef.symbols()} >= {"work", "main"}
+    # First-wins dedup: notes came from the runtime part only.
+    assert names.count(".note.gnu.build-id") == 1
+
+
+def test_compose_elf_cross_part_link_resolves_by_name(binary):
+    """A later part's .symtab whose pulled .strtab loses the first-wins
+    dedup must link the EARLIER part's .strtab by name — not dangle at
+    link=0 (review finding: symbol names would read the null section)."""
+    from parca_agent_tpu.elf.writer import compose_elf
+
+    out = compose_elf([
+        (binary, lambda s: s.name == ".strtab"),
+        (binary, lambda s: s.name == ".symtab"),
+    ])
+    ef = ElfFile(out)
+    by_name = {s.name: s for s in ef.sections}
+    link = by_name[".symtab"].link
+    assert link != 0
+    assert ef.sections[link].name == ".strtab"
+    assert {s.name for s in ef.symbols()} >= {"work", "main"}
+
+
+def test_compose_elf_first_wins_on_duplicate_names(binary):
+    from parca_agent_tpu.elf.writer import compose_elf
+
+    out = compose_elf([
+        (binary, lambda s: s.name == ".symtab"),
+        (binary, lambda s: s.name in (".symtab", ".strtab")),
+    ])
+    names = [s.name for s in ElfFile(out).sections]
+    assert names.count(".symtab") == 1
+    assert names.count(".strtab") == 1
+
+
 def test_filter_elf_drops_non_load_segments(binary):
     """Only PT_LOAD survives filtering: a copied PT_NOTE would point its
     stale file offset at unrelated bytes, and the reader's section-less

@@ -130,6 +130,76 @@ def test_debug_pprof_rejects_bad_seconds():
         srv.stop()
 
 
+def test_heap_self_profile_bounded_window():
+    import tracemalloc
+
+    from parca_agent_tpu.profiler.selfprofile import heap_self
+
+    blob = []
+
+    def alloc_during_window(_s):
+        blob.extend(bytearray(4096) for _ in range(100))
+
+    assert not tracemalloc.is_tracing()
+    prof = parse_pprof(heap_self(seconds=0.1, sleep=alloc_during_window))
+    # Tracing stopped when we started it: no lasting overhead.
+    assert not tracemalloc.is_tracing()
+    assert prof.sample_types == \
+        [("inuse_objects", "count"), ("inuse_space", "bytes")]
+    assert prof.samples, "window allocations not captured"
+    total_bytes = sum(v[1] for _, v, _ in prof.samples)
+    assert total_bytes >= 100 * 4096
+    del blob
+
+
+def test_heap_self_respects_external_tracing():
+    import tracemalloc
+
+    from parca_agent_tpu.profiler.selfprofile import heap_self
+
+    tracemalloc.start()
+    try:
+        junk = [dict(x=i) for i in range(2000)]  # noqa: F841
+        prof = parse_pprof(heap_self(seconds=30))  # immediate: no sleep
+        assert prof.samples
+        # Someone else's tracing is left running.
+        assert tracemalloc.is_tracing()
+    finally:
+        tracemalloc.stop()
+
+
+def test_debug_pprof_heap_endpoint():
+    from parca_agent_tpu.web import AgentHTTPServer
+
+    srv = AgentHTTPServer("127.0.0.1", 0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        done = threading.Event()
+
+        def churn():
+            junk = []
+            while not done.is_set():
+                junk = [dict(x=i) for i in range(1000)]  # noqa: F841
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            with urllib.request.urlopen(
+                    f"{base}/debug/pprof/heap?seconds=0.3", timeout=10) as r:
+                prof = parse_pprof(r.read())
+        finally:
+            done.set()
+            t.join()
+        assert prof.samples
+        with urllib.request.urlopen(f"{base}/debug/pprof/heap?seconds=0",
+                                    timeout=5) as r:
+            raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    finally:
+        srv.stop()
+
+
 def test_parse_pprof_reads_location_lines():
     # parse_pprof must expose lines for the self-profile assertions above;
     # guard that contract here so builder refactors keep it.
