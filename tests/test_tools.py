@@ -1,0 +1,40 @@
+"""Dev tools: snapshot inspector (tools/eh_frame is covered in
+test_dwarf_unwind)."""
+
+from parca_agent_tpu.capture.formats import save_snapshot
+from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+from parca_agent_tpu.tools.snapshot import format_summary, main
+
+
+def test_snapshot_summary(tmp_path, capsys):
+    snap = generate(SyntheticSpec(n_pids=7, n_unique_stacks=50, seed=2))
+    path = tmp_path / "w.snap"
+    save_snapshot(snap, str(path))
+
+    assert main([str(path), "--top", "2", "--pids", "2"]) == 0
+    out = capsys.readouterr().out
+    assert f"samples: {snap.total_samples()}" in out
+    assert "pids: 7" in out
+    assert "top stacks by count:" in out
+
+    text = format_summary(snap, top=1)
+    # The top stack line carries the highest count in the window.
+    assert f"x{int(snap.counts.max())}" in text
+
+
+def test_snapshot_summary_renders_kernel_only_stacks():
+    """user_len=0 rows still print their kernel frames (the slice uses
+    the combined depth, matching the snapshot stack layout)."""
+    import numpy as np
+
+    from parca_agent_tpu.capture.formats import MappingTable, WindowSnapshot
+
+    stacks = np.zeros((1, 128), np.uint64)
+    stacks[0, :5] = np.uint64(0xFFFF800000000000) + np.arange(
+        5, dtype=np.uint64)
+    snap = WindowSnapshot(pids=[9], tids=[9], counts=[4], user_len=[0],
+                          kernel_len=[5], stacks=stacks,
+                          mappings=MappingTable.empty())
+    out = format_summary(snap)
+    assert "0xffff800000000000" in out
+    assert "(+1)" in out  # 5 frames, 4 shown
