@@ -59,7 +59,7 @@ Scale knobs via env:
   PARCA_BENCH_REPS     (default 7)  TPU close reps (median)
   PARCA_BENCH_CPU_REPS (default 5)  CPU rebuild reps (median)
   PARCA_BENCH_BATCH    (default 1)  also bench the one-shot batch kernel
-  PARCA_BENCH_ATTEMPT_TIMEOUT_S (default 480) child wall-clock bound
+  PARCA_BENCH_ATTEMPT_TIMEOUT_S (default 600) child wall-clock bound
 """
 
 from __future__ import annotations
@@ -406,7 +406,7 @@ def main() -> None:
         _child_main()
         return
 
-    timeout_s = float(os.environ.get("PARCA_BENCH_ATTEMPT_TIMEOUT_S", 480))
+    timeout_s = float(os.environ.get("PARCA_BENCH_ATTEMPT_TIMEOUT_S", 600))
     errors: list[str] = []
     result: dict | None = None
 
