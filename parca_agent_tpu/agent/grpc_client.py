@@ -15,23 +15,35 @@ WRITE_RAW_METHOD = "/parca.profilestore.v1alpha1.ProfileStoreService/WriteRaw"
 DEBUGINFO_UPLOAD_METHOD = "/parca.debuginfo.v1alpha1.DebuginfoService/Upload"
 
 
+# Generous message bounds like the reference's MaxCallRecvMsgSize /
+# MaxCallSendMsgSize options (main.go:595-656): one batch can carry many
+# gzipped profiles plus debuginfo uploads share the channel.
+MAX_MSG_BYTES = 64 << 20
+
+
 class GRPCStoreClient:
     def __init__(self, address: str, insecure: bool = False,
-                 bearer_token: str = "", timeout_s: float = 30.0):
+                 bearer_token: str = "", timeout_s: float = 30.0,
+                 max_msg_bytes: int = MAX_MSG_BYTES):
         try:
             import grpc
         except ImportError as e:  # pragma: no cover - grpc is in the image
             raise RuntimeError("grpc package unavailable") from e
         self._grpc = grpc
         self._timeout = timeout_s
+        options = [
+            ("grpc.max_send_message_length", max_msg_bytes),
+            ("grpc.max_receive_message_length", max_msg_bytes),
+        ]
         if insecure:
-            self._channel = grpc.insecure_channel(address)
+            self._channel = grpc.insecure_channel(address, options=options)
         else:
             creds = grpc.ssl_channel_credentials()
             if bearer_token:
                 call_creds = grpc.access_token_call_credentials(bearer_token)
                 creds = grpc.composite_channel_credentials(creds, call_creds)
-            self._channel = grpc.secure_channel(address, creds)
+            self._channel = grpc.secure_channel(address, creds,
+                                                options=options)
         self._bearer = bearer_token if insecure else ""
         # Shared by the debuginfo client (one connection per server, like
         # the reference's single grpcConn, main.go:595-656).
