@@ -321,21 +321,10 @@ def run() -> dict:
                 pack_window_inputs,
             )
 
-            # Size l_cap from the exact unique-location count up front:
-            # every l_cap doubling would recompile the kernel (~20-40s
-            # through the tunnel), and the host can count (pid, frame)
-            # uniques in well under a second.
-            depth = snap.user_len.astype(np.int64) + \
-                snap.kernel_len.astype(np.int64)
-            row_idx = np.repeat(np.arange(len(snap)), depth)
-            col_idx = np.concatenate(
-                [np.arange(d) for d in depth]) if len(snap) else \
-                np.zeros(0, np.int64)
-            pairs = (snap.pids[row_idx].astype(np.uint64) << np.uint64(48)) \
-                ^ snap.stacks[row_idx, col_idx]
-            n_locs_host = len(np.unique(pairs))
-            l_cap = 1 << max(11, int(n_locs_host - 1).bit_length())
-            host_args, dims = pack_window_inputs(snap, l_cap=l_cap)
+            # l_cap=None sizes the location table from the exact
+            # unique-(pid, frame) count (pack_window_inputs), so no
+            # doubling recompile should ever fire.
+            host_args, dims = pack_window_inputs(snap)
             dev_args = tuple(jnp.asarray(a) for a in host_args)
             while True:
                 out = _jitted_kernel()(*dev_args, **dims)

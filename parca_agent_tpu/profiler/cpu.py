@@ -60,10 +60,19 @@ class CPUProfiler:
         duration_s: float = 10.0,
         fallback_aggregator: Aggregator | None = None,
         on_iteration: Callable[[int], None] | None = None,
+        device_timeout_s: float = 60.0,
+        device_retry_windows: int = 30,
     ):
         self._source = source
         self._aggregator = aggregator
         self._fallback = fallback_aggregator
+        self._device_timeout = device_timeout_s
+        self._device_retry_windows = device_retry_windows
+        # Hang containment state: the in-flight aggregation call when the
+        # device last wedged, and the window count at which it did.
+        self._device_inflight = None
+        self._device_wedged_at: int | None = None
+        self._windows_seen = 0
         self._symbolizer = symbolizer
         self._labels = labels_manager
         self._writer = profile_writer
@@ -82,20 +91,75 @@ class CPUProfiler:
 
     def obtain_profiles(self, snapshot: WindowSnapshot) -> list[PidProfile]:
         """Aggregate with the configured backend; fall back to the CPU path
-        when the device backend fails (SURVEY.md section 7 hard part #5:
-        device trouble must not stall the capture loop)."""
+        when the device backend fails OR HANGS (SURVEY.md section 7 hard
+        part #5: device trouble must not stall the capture loop — and a
+        wedged device runtime blocks inside a C call no exception ever
+        leaves, observed as multi-minute backend-init hangs on real
+        hardware). With a fallback configured, device aggregation runs on
+        a watchdog thread bounded by device_timeout_s; on timeout the
+        window is aggregated on the CPU and the device is retried only
+        after device_retry_windows windows AND once the abandoned call has
+        actually returned (the aggregator's state is not touched while a
+        wedged call may still be executing inside it)."""
         t0 = time.perf_counter()
-        try:
-            profiles = self._aggregator.aggregate(snapshot)
-        except Exception as e:
-            if self._fallback is None:
-                raise
-            _log.warn("device aggregation failed; using CPU fallback",
-                      aggregator=type(self._aggregator).__name__,
-                      error=repr(e))
-            profiles = self._fallback.aggregate(snapshot)
+        self._windows_seen += 1
+        # Device failures are handled (and logged as such) inside
+        # _aggregate_guarded; an exception escaping it is a FALLBACK (or
+        # no-fallback) failure and must propagate as an iteration error —
+        # re-running the fallback here would double the work and blame
+        # the wrong backend in the log.
+        profiles = self._aggregate_guarded(snapshot)
         self.metrics.last_aggregate_duration_s = time.perf_counter() - t0
         return profiles
+
+    def _aggregate_guarded(self, snapshot: WindowSnapshot):
+        if self._fallback is None:
+            return self._aggregator.aggregate(snapshot)
+
+        if self._device_wedged_at is not None:
+            # Device previously hung. Only retry after the cooldown and
+            # once the abandoned call has finished with the aggregator.
+            cooled = (self._windows_seen - self._device_wedged_at
+                      >= self._device_retry_windows)
+            if not (cooled and self._device_inflight.is_set()):
+                return self._fallback.aggregate(snapshot)
+            self._device_wedged_at = None
+            self._device_inflight = None
+            _log.info("retrying device aggregation after cooldown")
+
+        # A daemon thread, NOT a ThreadPoolExecutor: pool workers are
+        # non-daemon and joined at interpreter exit, so one wedged call
+        # would block agent shutdown forever. A daemon thread is truly
+        # abandonable.
+        box: dict = {}
+        done = threading.Event()
+
+        def call():
+            try:
+                box["out"] = self._aggregator.aggregate(snapshot)
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                box["err"] = e
+            finally:
+                done.set()
+
+        threading.Thread(target=call, name="aggregate-device",
+                         daemon=True).start()
+        if done.wait(self._device_timeout):
+            if "err" not in box:
+                return box["out"]
+            _log.warn("device aggregation failed; using CPU fallback",
+                      aggregator=type(self._aggregator).__name__,
+                      error=repr(box["err"]))
+        else:
+            self._device_wedged_at = self._windows_seen
+            self._device_inflight = done
+            _log.error(
+                "device aggregation hung; abandoning call and using the "
+                "CPU fallback",
+                aggregator=type(self._aggregator).__name__,
+                timeout_s=self._device_timeout,
+                retry_after_windows=self._device_retry_windows)
+        return self._fallback.aggregate(snapshot)
 
     def run_iteration(self) -> bool:
         """Returns False when the source is exhausted."""

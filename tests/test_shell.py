@@ -5,6 +5,7 @@ import gzip
 import io
 import json
 import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -73,6 +74,52 @@ def test_profiler_fallback_on_device_failure():
     )
     assert p.run_iteration()
     assert p.last_error is None and len(w.profiles) == 5
+
+
+def test_profiler_fallback_on_device_hang():
+    """A device call that never returns (wedged runtime inside a C call)
+    must not stall the window loop: the watchdog abandons it, the CPU
+    fallback aggregates, and the device is only retried after the
+    cooldown AND once the abandoned call finished (r3: observed
+    multi-minute backend-init hangs on real hardware)."""
+    import threading as _t
+
+    release = _t.Event()
+    calls = []
+
+    class Wedge:
+        name = "wedge"
+
+        def aggregate(self, snapshot):
+            calls.append(1)
+            release.wait(20)  # wedged until the test releases it
+            return CPUAggregator().aggregate(snapshot)
+
+    w = CollectingWriter()
+    snaps = [_snap() for _ in range(4)]
+    p = CPUProfiler(
+        source=ReplaySource(snaps),
+        aggregator=Wedge(),
+        fallback_aggregator=CPUAggregator(),
+        profile_writer=w,
+        device_timeout_s=0.2,
+        device_retry_windows=2,
+    )
+    t0 = time.monotonic()
+    assert p.run_iteration()          # hang -> watchdog -> fallback
+    assert time.monotonic() - t0 < 5
+    assert p.last_error is None and len(w.profiles) == 5
+    assert len(calls) == 1
+
+    assert p.run_iteration()          # cooldown: no device attempt
+    assert len(calls) == 1
+    release.set()                     # abandoned call completes...
+    assert p._device_inflight.wait(10)  # ...deterministically
+    assert p.run_iteration()          # window 3: cooldown reached, retry
+    assert len(calls) == 2
+    assert p.run_iteration()
+    assert len(w.profiles) == 4 * 5
+    assert p.last_error is None
 
 
 def test_profiler_iteration_failure_nonfatal():
