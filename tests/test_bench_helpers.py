@@ -1,0 +1,96 @@
+"""bench.py supervisor helpers (the measurement itself runs on hardware;
+these pin the pure-host pieces: JSON-line recovery, snapshot caching)."""
+
+import importlib.util
+import json
+import sys
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location("bench", "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _bench()
+
+
+def test_scan_json_line_takes_last_dict():
+    out = "\n".join([
+        "garbage",
+        json.dumps({"metric": "old"}),
+        "42",            # stray scalar: ignored
+        json.dumps({"metric": "new"}),
+        "null",          # stray scalar after the result: ignored
+    ])
+    assert bench._scan_json_line(out) == {"metric": "new"}
+    assert bench._scan_json_line("") is None
+    assert bench._scan_json_line("true\n7\n") is None
+
+
+def test_snapshot_path_fingerprints_spec(monkeypatch):
+    p1 = bench._snapshot_path(1024, 10)
+    assert p1 == bench._snapshot_path(1024, 10)  # deterministic
+    assert p1 != bench._snapshot_path(2048, 10)  # rows in the key
+    assert p1 != bench._snapshot_path(1024, 11)  # pids in the key
+
+    # ANY spec field change must change the cache file (stale-file guard).
+    orig = bench._bench_spec
+
+    def tweaked(rows, pids):
+        import dataclasses
+
+        return dataclasses.replace(orig(rows, pids), seed=43)
+
+    monkeypatch.setattr(bench, "_bench_spec", tweaked)
+    assert bench._snapshot_path(1024, 10) != p1
+
+
+def test_make_snapshot_roundtrips_through_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    import tempfile
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    s1 = bench._make_snapshot(64, 4)
+    cached = list(tmp_path.glob("parca_bench_snap_*.bin"))
+    assert len(cached) == 1
+    s2 = bench._make_snapshot(64, 4)  # loads, not regenerates
+    import numpy as np
+
+    np.testing.assert_array_equal(s1.counts, s2.counts)
+    np.testing.assert_array_equal(s1.stacks, s2.stacks)
+
+    # A corrupt cache regenerates instead of crashing.
+    cached[0].write_bytes(b"not a snapshot")
+    s3 = bench._make_snapshot(64, 4)
+    np.testing.assert_array_equal(s1.counts, s3.counts)
+
+
+def test_run_child_recovers_result_from_failing_child(monkeypatch):
+    """A child that prints its JSON and then dies (teardown crash) still
+    yields the measurement."""
+    import subprocess
+
+    def fake_run(argv, **kw):
+        return subprocess.CompletedProcess(
+            argv, returncode=1,
+            stdout=json.dumps({"metric": "m", "value": 1}) + "\n",
+            stderr="backend teardown exploded\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    got = bench._run_child(5.0)
+    assert got == {"metric": "m", "value": 1}
+
+
+def test_run_child_reports_hang(monkeypatch):
+    def fake_run(argv, **kw):
+        raise bench.subprocess.TimeoutExpired(
+            argv, kw.get("timeout"), output="",
+            stderr=b"[bench +  10.0s] first window\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    got = bench._run_child(7.0)
+    assert isinstance(got, str)
+    assert "hung >7s" in got
+    assert "first window" in got  # last progress line surfaced
