@@ -64,10 +64,12 @@ Scale knobs via env:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -144,9 +146,6 @@ def _bench_spec(rows: int, pids: int):
 def _snapshot_path(rows: int, pids: int) -> str:
     """Cache file for a spec; the name fingerprints the FULL spec so a
     spec/seed change can't serve a stale file."""
-    import hashlib
-    import tempfile
-
     tag = hashlib.sha1(repr(_bench_spec(rows, pids)).encode()).hexdigest()[:12]
     return os.path.join(tempfile.gettempdir(), f"parca_bench_snap_{tag}.bin")
 
@@ -437,8 +436,6 @@ def main() -> None:
     r_pids = int(reduced["PARCA_BENCH_PIDS"])
     keep = {os.path.basename(_snapshot_path(rows, pids)),
             os.path.basename(_snapshot_path(r_rows, r_pids))}
-    import tempfile
-
     tmpdir = tempfile.gettempdir()
     try:
         for name in os.listdir(tmpdir):

@@ -20,6 +20,7 @@ CONTENT still travels via its sections.
 
 from __future__ import annotations
 
+import dataclasses
 import struct
 
 from parca_agent_tpu.elf.reader import (
@@ -140,8 +141,6 @@ def compose_elf(parts: list[tuple[bytes, "callable"]]) -> bytes:
     from DIFFERENT builds must ensure the winning table is the right one
     (same caller contract as the reference's AggregatingWriter).
     """
-    import dataclasses as _dc
-
     w: ElfWriter | None = None
     seen: dict[str, int] = {}  # name -> combined table index (1-based)
     for data, keep in parts:
@@ -166,7 +165,7 @@ def compose_elf(parts: list[tuple[bytes, "callable"]]) -> bytes:
             if link == 0 and sec.link:
                 link = seen.get(ef.sections[sec.link].name, 0)
             seen[sec.name] = new_index[i]
-            w.add_section(_dc.replace(sec, link=link), ef.section_data(sec))
+            w.add_section(dataclasses.replace(sec, link=link), ef.section_data(sec))
     if w is None:
         raise ValueError("compose_elf needs at least one part")
     return w.serialize()
@@ -218,8 +217,7 @@ def filter_elf(data: bytes, keep) -> bytes:
     new_index = {old: new for new, old in enumerate(chosen, start=1)}
     for i in chosen:
         sec = secs[i]
-        import dataclasses as _dc
-
         new_link = new_index.get(sec.link, 0)
-        w.add_section(_dc.replace(sec, link=new_link), ef.section_data(sec))
+        w.add_section(dataclasses.replace(sec, link=new_link),
+                      ef.section_data(sec))
     return w.serialize()

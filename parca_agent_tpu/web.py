@@ -138,56 +138,39 @@ class AgentHTTPServer:
                         b"N-second tracing window\n"
                         b"  /debug/pprof/cmdline            "
                         b"agent command line\n"))
-                elif name == "heap":
-                    from parca_agent_tpu.profiler.selfprofile import (
-                        heap_self,
-                    )
-
-                    try:
-                        seconds = float(params.get("seconds", "5"))
-                    except ValueError:
-                        self._send(400, b"bad seconds parameter\n")
-                        return
-                    if not 0 < seconds <= 300:
-                        self._send(400, b"seconds must be in (0, 300]\n")
-                        return
-                    body = heap_self(seconds)
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "application/octet-stream")
-                    self.send_header("Content-Disposition",
-                                     'attachment; filename="heap.pb.gz"')
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
                 elif name == "cmdline":
                     import sys as _sys
 
                     self._send(200, "\x00".join(_sys.argv).encode())
-                elif name == "profile":
+                elif name in ("profile", "heap"):
                     from parca_agent_tpu.profiler.selfprofile import (
+                        heap_self,
                         profile_self,
                     )
 
+                    fn, default_s = ((profile_self, "10")
+                                     if name == "profile"
+                                     else (heap_self, "5"))
                     try:
-                        seconds = float(params.get("seconds", "10"))
+                        seconds = float(params.get("seconds", default_s))
                     except ValueError:
                         self._send(400, b"bad seconds parameter\n")
                         return
                     if not 0 < seconds <= 300:
                         self._send(400, b"seconds must be in (0, 300]\n")
                         return
-                    body = profile_self(seconds)
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "application/octet-stream")
-                    self.send_header("Content-Disposition",
-                                     'attachment; filename="profile.pb.gz"')
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send_attachment(fn(seconds), f"{name}.pb.gz")
                 else:
                     self._send(404, b"unknown profile\n")
+
+            def _send_attachment(self, body: bytes, filename: str):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Disposition",
+                                 f'attachment; filename="{filename}"')
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _query(self, url):
                 if outer.listener is None:
