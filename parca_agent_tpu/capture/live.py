@@ -98,6 +98,14 @@ def load_native():
                                      ctypes.c_long]
     lib.pa_sampler_destroy.restype = None
     lib.pa_sampler_destroy.argtypes = [ctypes.c_void_p]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.pa_decode_v1_count.restype = ctypes.c_long
+    lib.pa_decode_v1_count.argtypes = [u8p, ctypes.c_long, ctypes.c_long]
+    lib.pa_decode_v1.restype = ctypes.c_long
+    lib.pa_decode_v1.argtypes = [
+        u8p, ctypes.c_long, i32p, i32p, i32p, i32p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_long, ctypes.c_long]
     return lib
 
 
@@ -141,12 +149,47 @@ def decode_records_v2(buf: bytes) -> list[
     return out
 
 
-def records_to_snapshot(
-    records, mappings: MappingTable, period_ns: int, window_ns: int,
+def decode_records_columnar(lib, buf, nbytes: int) -> tuple:
+    """Native one-pass v1 decode straight into the columnar arrays
+    columns_to_snapshot needs — replaces two Python per-record loops on
+    the once-a-second capture path. `buf` is a ctypes uint8 buffer (or
+    bytes) whose first `nbytes` bytes are valid.
+
+    Returns (pids, tids, ulen, klen, stacks) with user frames first per
+    row (the WindowSnapshot contract; the native decoder reorders from
+    the drain's kernel-first packing).
+    """
+    if isinstance(buf, (bytes, bytearray)):
+        buf = (ctypes.c_uint8 * nbytes).from_buffer_copy(buf[:nbytes])
+    p = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+    n = int(lib.pa_decode_v1_count(p, nbytes, STACK_SLOTS))
+    pids = np.zeros(n, np.int32)
+    tids = np.zeros(n, np.int32)
+    ulen = np.zeros(n, np.int32)
+    klen = np.zeros(n, np.int32)
+    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+    if n:
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        got = int(lib.pa_decode_v1(
+            p, nbytes,
+            pids.ctypes.data_as(i32p),
+            tids.ctypes.data_as(i32p),
+            ulen.ctypes.data_as(i32p),
+            klen.ctypes.data_as(i32p),
+            stacks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            STACK_SLOTS, n))
+        assert got == n, (got, n)
+    return pids, tids, ulen, klen, stacks
+
+
+def columns_to_snapshot(
+    pids, tids, ulen, klen, stacks,
+    mappings: MappingTable, period_ns: int, window_ns: int,
 ) -> WindowSnapshot:
-    """Dedup identical (pid, tid, stack) records into counted rows
-    (the role the BPF stack_counts map plays in the reference)."""
-    n = len(records)
+    """Dedup identical (pid, tid, stack) rows into counted rows (the role
+    the BPF stack_counts map plays in the reference). Columnar input from
+    the native decoder or from records_to_snapshot's packing."""
+    n = len(pids)
     if n == 0:
         return WindowSnapshot(
             pids=np.zeros(0, np.int32), tids=np.zeros(0, np.int32),
@@ -156,21 +199,6 @@ def records_to_snapshot(
             mappings=mappings, period_ns=period_ns, window_ns=window_ns,
             time_ns=time.time_ns(),
         )
-    pids = np.zeros(n, np.int32)
-    tids = np.zeros(n, np.int32)
-    ulen = np.zeros(n, np.int32)
-    klen = np.zeros(n, np.int32)
-    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
-    for i, (pid, tid, kframes, uframes) in enumerate(records):
-        pids[i] = pid
-        tids[i] = tid
-        nu, nk = len(uframes), len(kframes)
-        ulen[i] = nu
-        klen[i] = nk
-        # formats.py contract: user frames first, then kernel tail.
-        stacks[i, :nu] = uframes
-        stacks[i, nu:nu + nk] = kframes
-
     # Vectorized row dedup (same byte-view trick as CPUAggregator).
     rec = np.zeros((n, STACK_SLOTS + 4), np.uint64)
     rec[:, 0] = pids.astype(np.uint64)
@@ -188,6 +216,30 @@ def records_to_snapshot(
         mappings=mappings, period_ns=period_ns, window_ns=window_ns,
         time_ns=time.time_ns(),
     )
+
+
+def records_to_snapshot(
+    records, mappings: MappingTable, period_ns: int, window_ns: int,
+) -> WindowSnapshot:
+    """Tuple-record variant of columns_to_snapshot (the DWARF path's
+    walker rewrites per-record user chains, so it stays tuple-shaped)."""
+    n = len(records)
+    pids = np.zeros(n, np.int32)
+    tids = np.zeros(n, np.int32)
+    ulen = np.zeros(n, np.int32)
+    klen = np.zeros(n, np.int32)
+    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+    for i, (pid, tid, kframes, uframes) in enumerate(records):
+        pids[i] = pid
+        tids[i] = tid
+        nu, nk = len(uframes), len(kframes)
+        ulen[i] = nu
+        klen[i] = nk
+        # formats.py contract: user frames first, then kernel tail.
+        stacks[i, :nu] = uframes
+        stacks[i, nu:nu + nk] = kframes
+    return columns_to_snapshot(pids, tids, ulen, klen, stacks,
+                               mappings, period_ns, window_ns)
 
 
 class UnwindTableCache:
@@ -413,35 +465,49 @@ class PerfEventSampler:
     def truncated_drains(self) -> int:
         return int(self._lib.pa_sampler_truncated(self._handle))
 
-    def _drain(self) -> bytes:
-        """Lossless drain: loops while the native side reports records left
-        behind for lack of buffer space."""
-        chunks = []
+    def _drain_passes(self, consume) -> None:
+        """Lossless drain: loops while the native side reports records
+        left behind for lack of buffer space, handing each pass's
+        (buffer, n_bytes) to `consume` before the buffer is reused."""
         for _ in range(64):  # safety bound; one pass is the norm
             before = self.truncated_drains
-            buf = self._drainbuf
             n = self._lib.pa_sampler_drain(
-                self._handle, buf, ctypes.c_long(self._cap))
+                self._handle, self._drainbuf, ctypes.c_long(self._cap))
             if n < 0:
                 raise SamplerUnavailable("sampler drain failed")
             if n:
-                chunks.append(ctypes.string_at(buf, n))
+                consume(self._drainbuf, int(n))
             if self.truncated_drains == before:
                 break
+
+    def _drain(self) -> bytes:
+        chunks = []
+        self._drain_passes(
+            lambda buf, n: chunks.append(ctypes.string_at(buf, n)))
         return b"".join(chunks)
+
+    def _drain_columnar(self) -> list[tuple]:
+        """Lossless drain with the native columnar decoder applied per
+        pass, straight off the reusable drain buffer (no bytes copy)."""
+        cols = []
+        self._drain_passes(
+            lambda buf, n: cols.append(
+                decode_records_columnar(self._lib, buf, n)))
+        return cols
 
     def poll(self) -> WindowSnapshot:
         deadline = time.monotonic() + self._window
         # Drain mid-window too so a ring never wraps (the reference sizes
         # BPF maps for a full window; perf rings are smaller).
         records = []
+        col_chunks: list[tuple] = []
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             time.sleep(min(1.0, remaining))
-            raw = self._drain()
             if self.capture_stack:
+                raw = self._drain()
                 v2 = decode_records_v2(raw)
                 # Queue table builds early so they're ready within the
                 # window (matches the 5 s watch cadence).
@@ -453,18 +519,31 @@ class PerfEventSampler:
                                    trust_fp_frames=self._trust_fp_frames,
                                    stats=self.walk_stats))
             else:
-                records.extend(decode_records(raw))
+                col_chunks.extend(self._drain_columnar())
+
+        if self.capture_stack:
+            pid_iter = sorted({r[0] for r in records})
+        else:
+            cols = [np.concatenate([c[i] for c in col_chunks])
+                    if col_chunks else z
+                    for i, z in enumerate((
+                        np.zeros(0, np.int32), np.zeros(0, np.int32),
+                        np.zeros(0, np.int32), np.zeros(0, np.int32),
+                        np.zeros((0, STACK_SLOTS), np.uint64)))]
+            pid_iter = np.unique(cols[0]).tolist()
         per_pid = {}
-        for pid in sorted({r[0] for r in records}):
+        for pid in pid_iter:
             try:
                 per_pid[pid] = self._maps.executable_mappings(pid)
             except OSError:
                 continue
         table = build_mapping_table(per_pid, self._objs.build_ids(per_pid),
                                     objcache=self._objs)
-        return records_to_snapshot(
-            records, table, int(1e9 / self._freq), int(self._window * 1e9),
-        )
+        period_ns = int(1e9 / self._freq)
+        window_ns = int(self._window * 1e9)
+        if self.capture_stack:
+            return records_to_snapshot(records, table, period_ns, window_ns)
+        return columns_to_snapshot(*cols, table, period_ns, window_ns)
 
     def close(self) -> None:
         if self._handle:

@@ -366,6 +366,59 @@ long pa_sampler_drain(Sampler* s, uint8_t* out, long cap) {
   return written;
 }
 
+// ---- v1 drain decode: packed records -> columnar arrays ---------------
+// Per record: u32 pid, tid, nk, nu | (nk + nu) u64 frames, KERNEL first
+// (the drain writer above). Decoding in native code replaces two Python
+// per-record loops on the once-a-second capture path. Both functions
+// stop at a corrupt/truncated tail exactly like the Python decoder, so
+// the prefix parsed so far is kept.
+
+// stack_slots is passed here too so count and decode apply the SAME
+// acceptance rule and can never disagree on the record count.
+long pa_decode_v1_count(const uint8_t* buf, long len, long stack_slots) {
+  long pos = 0, n = 0;
+  while (pos + 16 <= len) {
+    uint32_t hdr[4];
+    std::memcpy(hdr, buf + pos, 16);
+    long nf = (long)hdr[2] + (long)hdr[3];
+    if (nf > (long)kMaxFrames || nf > stack_slots ||
+        pos + 16 + 8 * nf > len)
+      break;
+    pos += 16 + 8 * nf;
+    n++;
+  }
+  return n;
+}
+
+// stacks: [cap][stack_slots] u64, written USER frames first then kernel
+// tail (the WindowSnapshot row contract); rows must be pre-zeroed by the
+// caller. Returns the number of records written.
+long pa_decode_v1(const uint8_t* buf, long len,
+                  int32_t* pids, int32_t* tids,
+                  int32_t* ulen, int32_t* klen,
+                  uint64_t* stacks, long stack_slots, long cap) {
+  long pos = 0, n = 0;
+  while (pos + 16 <= len && n < cap) {
+    uint32_t hdr[4];
+    std::memcpy(hdr, buf + pos, 16);
+    long nk = hdr[2], nu = hdr[3];
+    long nf = nk + nu;
+    if (nf > (long)kMaxFrames || nf > stack_slots ||
+        pos + 16 + 8 * nf > len)
+      break;
+    pids[n] = (int32_t)hdr[0];
+    tids[n] = (int32_t)hdr[1];
+    klen[n] = (int32_t)nk;
+    ulen[n] = (int32_t)nu;
+    uint64_t* row = stacks + n * stack_slots;
+    std::memcpy(row, buf + pos + 16 + 8 * nk, 8 * nu);
+    std::memcpy(row + nu, buf + pos + 16, 8 * nk);
+    pos += 16 + 8 * nf;
+    n++;
+  }
+  return n;
+}
+
 void pa_sampler_destroy(Sampler* s) {
   if (!s) return;
   pa_sampler_stop(s);

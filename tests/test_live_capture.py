@@ -46,6 +46,52 @@ def test_decode_records():
     assert len(recs) == 2
 
 
+def test_native_columnar_decode_matches_python():
+    """pa_decode_v1 (one native pass into columnar arrays) agrees with the
+    Python reference decoder, including user-first row layout, prefix-keep
+    on a corrupt tail, and randomized record streams."""
+    import numpy as np
+
+    from parca_agent_tpu.capture.formats import STACK_SLOTS
+    from parca_agent_tpu.capture.live import (
+        decode_records_columnar,
+        load_native,
+    )
+
+    lib = load_native()
+    rng = np.random.default_rng(11)
+    bufs = [
+        _pack(7, 8, [0xFFFF800000000010], [0x401000, 0x401100]) +
+        _pack(9, 9, [], [0x55000]),
+        b"",
+    ]
+    # Random stream of 200 records with varied depths (incl. empty).
+    blob = b""
+    for _ in range(200):
+        nk = int(rng.integers(0, 4))
+        nu = int(rng.integers(0, 30))
+        blob += _pack(int(rng.integers(1, 1 << 21)),
+                      int(rng.integers(1, 1 << 21)),
+                      rng.integers(1, 1 << 62, nk).tolist(),
+                      rng.integers(1, 1 << 62, nu).tolist())
+    bufs.append(blob)
+    bufs.append(blob + b"\x05\x00\x00\x00")  # corrupt tail: prefix kept
+
+    for buf in bufs:
+        recs = decode_records(buf)
+        pids, tids, ulen, klen, stacks = decode_records_columnar(
+            lib, buf, len(buf))
+        assert len(pids) == len(recs)
+        for i, (pid, tid, kf, uf) in enumerate(recs):
+            assert (pids[i], tids[i]) == (pid, tid)
+            assert (ulen[i], klen[i]) == (len(uf), len(kf))
+            np.testing.assert_array_equal(stacks[i, :len(uf)], uf)
+            np.testing.assert_array_equal(
+                stacks[i, len(uf):len(uf) + len(kf)], kf)
+            assert not stacks[i, len(uf) + len(kf):].any()
+        assert stacks.shape[1] == STACK_SLOTS if len(recs) else True
+
+
 def test_records_to_snapshot_dedups():
     recs = decode_records(
         _pack(7, 7, [0xFFFF800000000010], [0x401000]) * 3
