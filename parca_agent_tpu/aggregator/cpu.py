@@ -146,12 +146,16 @@ class CPUAggregator:
             return []
         # Exact stack dedup: byte-compare rows of [pid, user_len, kernel_len,
         # frames...]. user/kernel lengths are part of the key so a same-address
-        # trace with a different user/kernel boundary stays distinct.
-        rec = np.zeros((n, STACK_SLOTS + 3), np.uint64)
+        # trace with a different user/kernel boundary stays distinct. Compare
+        # only up to the window's deepest stack — slots past it are zero in
+        # every row, so the result is identical and the sort touches ~3x
+        # less data at typical depths.
+        max_depth = int((snapshot.user_len + snapshot.kernel_len).max())
+        rec = np.zeros((n, max_depth + 3), np.uint64)
         rec[:, 0] = snapshot.pids.astype(np.uint64)
         rec[:, 1] = snapshot.user_len.astype(np.uint64)
         rec[:, 2] = snapshot.kernel_len.astype(np.uint64)
-        rec[:, 3:] = snapshot.stacks
+        rec[:, 3:] = snapshot.stacks[:, :max_depth]
         void = np.ascontiguousarray(rec).view(
             np.dtype((np.void, rec.shape[1] * 8))
         ).ravel()
