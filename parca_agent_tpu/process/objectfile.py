@@ -24,14 +24,40 @@ from parca_agent_tpu.utils.vfs import VFS, RealFS
 
 
 class ObjectFile:
-    """One opened ELF + the mapping it was sampled through."""
+    """The slice of an opened ELF that address normalization needs, plus
+    the mapping it was sampled through.
 
-    def __init__(self, path: str, elf: ElfFile, mapping: ProcMapping):
+    Deliberately does NOT hold the parsed ElfFile (whole-file bytes): an
+    always-on agent's cache held ~1.3 GiB of binaries this way, yet base
+    computation only ever reads e_type and the executable PT_LOAD, and
+    upload keys only need the build id. The file is re-opened on the rare
+    paths that need sections (debuginfo extraction reads it itself)."""
+
+    def __init__(self, path: str, elf: ElfFile, mapping: ProcMapping,
+                 build_id: str | None = None):
+        from parca_agent_tpu.elf.buildid import build_id as _compute
+
         self.path = path
-        self.elf = elf
         self.mapping = mapping
-        self.build_id = build_id(elf) or ""
+        self.e_type = elf.e_type
+        self.exec_segment = elf.exec_load_segment()
+        # The cache passes the per-file build id it computed once; direct
+        # constructions compute it here.
+        self.build_id = (_compute(elf) or "") if build_id is None else build_id
         self._base: int | None = None
+
+    @classmethod
+    def from_meta(cls, path: str, e_type: int, exec_segment, build_id: str,
+                  mapping: ProcMapping) -> "ObjectFile":
+        """Construct from the cache's extracted metadata, no ElfFile."""
+        self = cls.__new__(cls)
+        self.path = path
+        self.mapping = mapping
+        self.e_type = e_type
+        self.exec_segment = exec_segment
+        self.build_id = build_id
+        self._base = None
+        return self
 
     def base(self, stext_offset: int | None = None) -> int:
         """Relocation base, computed once per object file (lazy, like the
@@ -39,7 +65,7 @@ class ObjectFile:
         if self._base is None:
             m = self.mapping
             self._base = compute_base(
-                self.elf, self.elf.exec_load_segment(),
+                self.e_type, self.exec_segment,
                 m.start, m.end, m.offset, stext_offset=stext_offset,
             )
         return self._base
@@ -60,8 +86,31 @@ class ObjectFileCache:
         self._ttl = ttl_s
         self._clock = clock
         self._cache: OrderedDict[tuple, tuple[float, ObjectFile | None]] = OrderedDict()
+        # Underlying-file identity -> (e_type, exec seg, build id); see _file_meta.
+        self._elves: OrderedDict[tuple, tuple[int, object, str]] = OrderedDict()
         self.hits = 0
         self.misses = 0
+
+    def _file_meta(self, path: str) -> tuple[int, object, str]:
+        """(e_type, exec PT_LOAD segment, build id) per underlying FILE —
+        stat identity incl. device — shared across the per-(pid, mapping)
+        entries: libc mapped into hundreds of processes parses and
+        build-id-hashes once, and the file BYTES are dropped immediately
+        after extraction (holding whole ElfFiles cost ~1.3 GiB on a host
+        with large binaries; normalization needs only these three
+        values). A read snapshot, not an mmap: a file truncated in place
+        under an mmap SIGBUSes the process, uncatchably."""
+        sig = self._fs.stat_signature(path)
+        hit = self._elves.get(sig)
+        if hit is not None:
+            self._elves.move_to_end(sig)
+            return hit
+        elf = ElfFile(self._fs.read_bytes(path))
+        entry = (elf.e_type, elf.exec_load_segment(), build_id(elf) or "")
+        self._elves[sig] = entry
+        while len(self._elves) > self._size:
+            self._elves.popitem(last=False)
+        return entry
 
     def get(self, pid: int, mapping: ProcMapping) -> ObjectFile | None:
         """None when the mapped file is unreadable or not a supported ELF
@@ -76,8 +125,9 @@ class ObjectFileCache:
         self.misses += 1
         obj: ObjectFile | None = None
         try:
-            data = self._fs.read_bytes(host_path(pid, mapping.path))
-            obj = ObjectFile(mapping.path, ElfFile(data), mapping)
+            e_type, seg, bid = self._file_meta(host_path(pid, mapping.path))
+            obj = ObjectFile.from_meta(mapping.path, e_type, seg, bid,
+                                       mapping)
         except (OSError, ElfError, BaseError):
             obj = None
         self._cache[key] = (now, obj)

@@ -18,7 +18,12 @@ class VFS(Protocol):
     def exists(self, path: str) -> bool: ...
     def listdir(self, path: str) -> list[str]: ...
     def open(self, path: str) -> io.BufferedIOBase: ...
-    def stat_signature(self, path: str) -> tuple: ...
+
+    def stat_signature(self, path: str) -> tuple:
+        """Cheap file-identity tuple for change detection and cross-path
+        dedup. Must distinguish files across devices (the agent reads
+        through /proc/<pid>/root/, crossing container mounts)."""
+        ...
 
 
 class RealFS:
@@ -39,7 +44,9 @@ class RealFS:
 
     def stat_signature(self, path: str) -> tuple:
         st = os.stat(path)
-        return (st.st_size, st.st_mtime_ns, st.st_ino)
+        # st_dev matters: inode numbers are only unique per device, and
+        # /proc/<pid>/root paths cross container filesystems.
+        return (st.st_dev, st.st_ino, st.st_size, st.st_mtime_ns)
 
 
 class FakeFS:
@@ -78,7 +85,12 @@ class FakeFS:
 
     def stat_signature(self, path: str) -> tuple:
         data = self.read_bytes(path)
-        return (len(data), self._version, 0)
+        # Content hash stands in for (dev, inode): distinct fake files
+        # must never collide just by having equal lengths.
+        import hashlib
+
+        digest = hashlib.blake2b(data, digest_size=8).hexdigest()
+        return (digest, len(data), self._version)
 
 
 class ErrorFS:

@@ -108,6 +108,41 @@ def test_objectfile_cache_and_normalize(pie_binary):
     assert cache.build_ids({5: maps}) == {"/app/p": obj.build_id}
 
 
+def test_objectfile_shared_elf_across_pids(pie_binary):
+    """The SAME underlying file mapped by many pids parses once: all
+    ObjectFiles share one ElfFile and one computed build id (an always-on
+    agent must not hold a whole-file copy per (pid, mapping))."""
+    from parca_agent_tpu.elf.reader import ElfFile
+
+    seg = ElfFile(pie_binary).exec_load_segment()
+    offset = (seg.offset // 4096) * 4096
+    files = {}
+    for pid in (5, 6, 7):
+        files[f"/proc/{pid}/root/app/p"] = pie_binary
+    fs = FakeFS(files)
+    cache = ObjectFileCache(fs=fs)
+    pm = ProcMapping(0x7F0000000000 + offset,
+                     0x7F0000000000 + offset + seg.filesz,
+                     "r-xp", offset, "08:02", 42, "/app/p")
+    objs = [cache.get(pid, pm) for pid in (5, 6, 7)]
+    assert all(o is not None for o in objs)
+    # One parse for all three pids; the ObjectFiles hold only the
+    # extracted metadata (no whole-file bytes anywhere).
+    assert len(cache._elves) == 1
+    assert objs[0].exec_segment is objs[1].exec_segment is objs[2].exec_segment
+    assert len({o.build_id for o in objs}) == 1
+    assert not any(hasattr(o, "elf") for o in objs)
+
+    # Distinct files (same size, different content) do NOT collide.
+    other = bytearray(pie_binary)
+    other[-1] ^= 0xFF
+    fs.put("/proc/8/root/app/q", bytes(other))
+    pm_q = ProcMapping(pm.start, pm.end, "r-xp", offset, "08:02", 43,
+                       "/app/q")
+    obj_q = cache.get(8, pm_q)
+    assert obj_q is not None and len(cache._elves) == 2
+
+
 def test_objectfile_ttl_expiry(pie_binary):
     from parca_agent_tpu.process.maps import parse_proc_maps as parse
 
