@@ -271,6 +271,7 @@ class UnwindTableCache:
         self._cv = threading.Condition(self._lock)
         self._stop = False
         self._worker: threading.Thread | None = None
+        self._last_evict = 0.0
         self.stats = {"builds": 0, "build_errors": 0}
 
     def _comm(self, pid: int) -> str:
@@ -307,12 +308,21 @@ class UnwindTableCache:
 
     def _run(self) -> None:
         while True:
+            pid = None
             with self._cv:
-                while not self._queue and not self._stop:
+                if not self._queue and not self._stop:
                     self._cv.wait(timeout=1.0)
                 if self._stop:
                     return
-                pid = self._queue.pop(0)
+                if self._queue:
+                    pid = self._queue.pop(0)
+            if pid is None:
+                # Idle tick: matched processes may ALL have exited, in
+                # which case no build ever requeues and the per-build
+                # sweep below would never run. _evict_dead self-rate-
+                # limits, so idle ticks cost one monotonic read.
+                self._evict_dead()
+                continue
             from parca_agent_tpu.unwind.table import ShardedTable
 
             try:
@@ -341,6 +351,30 @@ class UnwindTableCache:
             finally:
                 with self._lock:
                     self._qset.discard(pid)
+                self._evict_dead()
+
+    def _evict_dead(self) -> None:
+        """Drop tables for exited pids so an always-on agent's table
+        memory tracks the LIVE process set instead of growing forever
+        under pid churn (same bounded-memory stance as the aggregator's
+        cold-id rotation). Runs opportunistically after builds, at most
+        once per refresh interval."""
+        now = time.monotonic()
+        if now - self._last_evict < self._refresh:
+            return
+        self._last_evict = now
+        with self._lock:
+            pids = list(self._tables)
+        dead = [p for p in pids
+                if not self._fs.exists(f"/proc/{p}/comm")]
+        if not dead:
+            return
+        with self._lock:
+            for p in dead:
+                self._tables.pop(p, None)
+                self._built_at.pop(p, None)
+        self.stats["evicted"] = self.stats.get("evicted", 0) + len(dead)
+        _log.debug("evicted unwind tables for exited pids", count=len(dead))
 
     def build_now(self, pid: int) -> "ShardedTable | None":
         """Synchronous build (tests / tools)."""

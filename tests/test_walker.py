@@ -359,6 +359,42 @@ def test_unwind_records_walks_mixed_fp_stacks():
     assert len(out[0][3]) == 2  # FP chain kept, no walk
 
 
+def test_unwind_table_cache_evicts_dead_pids():
+    """Tables for exited pids are dropped (bounded memory under pid
+    churn); live pids keep theirs."""
+    from parca_agent_tpu.capture.live import UnwindTableCache
+    from parca_agent_tpu.process.maps import ProcMapping
+    from parca_agent_tpu.utils.vfs import FakeFS
+
+    with open(os.path.join(FIXDIR, "fixture_pie"), "rb") as f:
+        elf = f.read()
+    fs = FakeFS({
+        "/proc/1/comm": b"live\n",
+        "/proc/1/root/bin/app": elf,
+        "/proc/2/comm": b"dying\n",
+        "/proc/2/root/bin/app": elf,
+    })
+
+    class Maps:
+        def executable_mappings(self, pid):
+            return [ProcMapping(0x1000, 0x5000, "r-xp", 0x1000, "08:01",
+                                7, "/bin/app")]
+
+    cache = UnwindTableCache(Maps(), refresh_s=0.0, fs=fs)
+    try:
+        assert cache.build_now(1) is not None
+        assert cache.build_now(2) is not None
+        assert set(cache._tables) == {1, 2}
+        # pid 2 exits; the next worker pass evicts its table.
+        del fs.files["/proc/2/comm"]
+        cache._last_evict = 0.0
+        cache._evict_dead()
+        assert set(cache._tables) == {1}
+        assert cache.stats["evicted"] == 1
+    finally:
+        cache.close()
+
+
 def test_fixture_unwind_table_covers_functions():
     """The compact table built from the checked-in no-FP fixture must cover
     its .text (golden-fixture variant of unwind_table_test.go:26-41)."""
