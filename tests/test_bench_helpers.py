@@ -80,7 +80,28 @@ def test_run_child_recovers_result_from_failing_child(monkeypatch):
 
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     got = bench._run_child(5.0)
-    assert got == {"metric": "m", "value": 1}
+    assert got["metric"] == "m" and got["value"] == 1
+    assert "rc=1" in got["attempt_note"]  # teardown crash is marked
+
+
+def test_run_child_recovers_provisional_line_from_hung_child(monkeypatch):
+    """The r3 failure mode: the measurement finished and emitted the
+    flushed provisional headline, then an optional extra hung past the
+    attempt timeout. The supervisor must recover the provisional dict
+    from the captured stdout instead of scoring the attempt failed."""
+
+    def fake_run(argv, **kw):
+        raise bench.subprocess.TimeoutExpired(
+            argv, kw.get("timeout"),
+            output=json.dumps({"metric": "m", "value": 121.9}) + "\n",
+            stderr=b"[bench + 360.0s] A/B sketch done\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    got = bench._run_child(600.0)
+    assert got["metric"] == "m" and got["value"] == 121.9
+    # The truncation is marked: a scavenged attempt must not read as a
+    # clean run whose extras were merely disabled.
+    assert "hung >600s" in got["attempt_note"]
 
 
 def test_run_child_reports_hang(monkeypatch):
