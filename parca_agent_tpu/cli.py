@@ -261,9 +261,11 @@ def run(argv=None) -> int:
 
         providers["cgroup"] = CgroupContainerDiscoverer()
     if args.enable_kubernetes_discovery:
+        from parca_agent_tpu.discovery.cri import CRIResolver
         from parca_agent_tpu.discovery.kubernetes import PodDiscoverer
 
-        providers["kubernetes"] = PodDiscoverer(node=args.node or None)
+        providers["kubernetes"] = PodDiscoverer(node=args.node or None,
+                                                cri=CRIResolver())
     discovery.apply_config(providers)
 
     sd_provider = ServiceDiscoveryProvider()

@@ -28,6 +28,7 @@ import dataclasses
 import json
 import os
 import threading
+import time
 from typing import Callable, Protocol
 
 from parca_agent_tpu.discovery.cgroup import CgroupContainerDiscoverer
@@ -43,6 +44,7 @@ class ContainerInfo:
     name: str
     container_id: str  # bare 64-hex id, runtime prefix stripped
     running: bool = True
+    raw_id: str = ""   # prefixed form ('containerd://<hex>') for CRI dispatch
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,8 +84,8 @@ def parse_pod_list(doc: dict) -> list[PodInfo]:
         containers = []
         for cs in _field(status, "containerStatuses",
                          "container_statuses") or []:
-            cid = strip_runtime_prefix(
-                _field(cs, "containerID", "container_id") or "")
+            raw = _field(cs, "containerID", "container_id") or ""
+            cid = strip_runtime_prefix(raw)
             if not cid:
                 continue  # not started yet
             containers.append(ContainerInfo(
@@ -91,6 +93,7 @@ def parse_pod_list(doc: dict) -> list[PodInfo]:
                 container_id=cid,
                 running="running" in {k for k, v in
                                       (cs.get("state") or {}).items() if v},
+                raw_id=raw,
             ))
         pods.append(PodInfo(
             name=meta.get("name") or "",
@@ -178,6 +181,24 @@ class PodDiscoverer:
     cgroups: CgroupContainerDiscoverer = dataclasses.field(
         default_factory=CgroupContainerDiscoverer
     )
+    # Fallback pid resolver for containers the cgroup scan missed — the
+    # scan/list race (container started between the two) and transient
+    # /proc read failures: anything with pid_from_container_id(raw_id)
+    # -> int, normally discovery.cri.CRIResolver. None disables the
+    # fallback. The runtime answers with a HOST-namespace pid, so the
+    # answer is adopted only after _validate_cri_pid confirms that pid's
+    # cgroup names this container in this agent's /proc view (needs
+    # hostPID, which deploy/daemonset.yaml mandates; an agent outside
+    # the host pid namespace rejects the pid instead of mislabeling a
+    # stranger, and a cgroup layout that hides the id entirely stays
+    # unresolved by design).
+    cri: object | None = None
+    # Failed CRI resolutions are not retried for this long: each attempt
+    # can block scrape() for the client's dial timeout, and container
+    # churn makes "status says running, runtime says gone" routine.
+    cri_negative_ttl_s: float = 30.0
+    _cri_failed_until: dict = dataclasses.field(default_factory=dict,
+                                                repr=False)
 
     def __post_init__(self):
         if not self.node:
@@ -185,6 +206,40 @@ class PodDiscoverer:
 
             self.node = (os.environ.get("KUBERNETES_NODE_NAME")
                          or socket.gethostname())
+
+    def _validate_cri_pid(self, pid: int, container_id: str) -> bool:
+        """The runtime reports the container's pid in the HOST pid
+        namespace. Adopt it only if this agent's /proc agrees it is that
+        container's process: /proc/<pid>/cgroup must mention the bare
+        container id. An agent outside the host pid namespace (or a pid
+        raced by reuse) fails this check and the pid is discarded rather
+        than profiled under a stranger's labels."""
+        try:
+            cg = self.cgroups.fs.read_bytes(f"/proc/{pid}/cgroup")
+        except OSError:
+            return False
+        return container_id.encode() in cg
+
+    def _cri_fallback(self, cs: ContainerInfo) -> list[int]:
+        """Ask the runtime itself (the reference's only path,
+        containerruntimes.go:78-81) when the cgroup scan is blind, with a
+        negative cache so a dead/slow runtime socket cannot stall every
+        poll."""
+        now = time.monotonic()
+        if self._cri_failed_until.get(cs.container_id, 0) > now:
+            return []
+        try:
+            pid = self.cri.pid_from_container_id(cs.raw_id)
+            if self._validate_cri_pid(pid, cs.container_id):
+                return [pid]
+        except Exception:  # noqa: BLE001 - runtime may be absent
+            pass
+        self._cri_failed_until[cs.container_id] = (
+            now + self.cri_negative_ttl_s)
+        if len(self._cri_failed_until) > 4096:  # bound on churny nodes
+            self._cri_failed_until = {
+                k: v for k, v in self._cri_failed_until.items() if v > now}
+        return []
 
     def scrape(self) -> list[Group]:
         if self.lister is None:
@@ -197,6 +252,9 @@ class PodDiscoverer:
         for pod in pods:
             for cs in pod.containers:
                 pids = pid_groups.get(cs.container_id, [])
+                if not pids and self.cri is not None and cs.running \
+                        and cs.raw_id:
+                    pids = self._cri_fallback(cs)
                 if not pids:
                     continue  # not on this node / already exited
                 groups.append(Group(

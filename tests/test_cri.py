@@ -1,0 +1,328 @@
+"""Per-runtime CRI pid resolution (discovery/cri.py) against fake
+runtimes speaking the real wire formats: a docker-engine HTTP server on a
+unix socket and a CRI gRPC RuntimeService (reference
+kubernetes/containerruntimes/{docker,containerd,crio})."""
+
+import http.server
+import json
+import os
+import socketserver
+import threading
+
+import pytest
+
+from parca_agent_tpu.discovery.cri import (
+    CRIError,
+    CRIResolver,
+    ContainerdClient,
+    CrioClient,
+    DockerClient,
+    decode_container_status_info,
+    encode_container_status_request,
+    encode_container_status_response,
+    split_runtime_prefix,
+)
+from parca_agent_tpu.pprof.proto import iter_fields
+
+
+def test_split_runtime_prefix():
+    assert split_runtime_prefix("docker://abc") == ("docker", "abc")
+    assert split_runtime_prefix("cri-o://ff00") == ("cri-o", "ff00")
+    with pytest.raises(CRIError):
+        split_runtime_prefix("abc123")  # no prefix
+    with pytest.raises(CRIError):
+        split_runtime_prefix("containerd://")  # empty id
+
+
+def test_container_status_wire_roundtrip():
+    req = encode_container_status_request("deadbeef")
+    fields = {f: v for f, _w, v in iter_fields(req)}
+    assert fields[1] == b"deadbeef"
+    assert fields[2] == 1  # verbose=true: required for the info JSON
+
+    info = {"info": json.dumps({"pid": 4242}), "other": "x"}
+    assert decode_container_status_info(
+        encode_container_status_response(info)) == info
+
+
+@pytest.fixture
+def docker_sock(tmp_path):
+    """Fake docker engine: ContainerInspect over a unix socket."""
+    path = str(tmp_path / "docker.sock")
+    containers = {"aaa111": 1234, "stopped": 0}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            cid = self.path.split("/")[2]
+            if cid not in containers:
+                self.send_response(404)
+                self.end_headers()
+                self.wfile.write(b'{"message":"no such container"}')
+                return
+            body = json.dumps(
+                {"Id": cid, "State": {"Pid": containers[cid],
+                                      "Running": True}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    class Server(socketserver.UnixStreamServer):
+        pass
+
+    srv = Server(path, Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield path
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_docker_client_resolves_pid(docker_sock):
+    c = DockerClient(socket_path=docker_sock)
+    assert c.pid_from_container_id("docker://aaa111") == 1234
+    with pytest.raises(CRIError):  # engine 404
+        c.pid_from_container_id("docker://missing")
+    with pytest.raises(CRIError):  # State.Pid == 0: not running
+        c.pid_from_container_id("docker://stopped")
+    with pytest.raises(CRIError):  # wrong runtime prefix
+        c.pid_from_container_id("containerd://aaa111")
+
+
+@pytest.fixture
+def cri_server():
+    """Fake CRI RuntimeService: real grpc server, hand-encoded replies,
+    serving runtime.v1 only (the v1alpha2 fallback path is exercised by
+    its UNIMPLEMENTED answer for v1 when configured)."""
+    import grpc
+
+    containers = {"bbb222": 4321}
+    state = {"api": "runtime.v1", "requests": []}
+
+    def container_status(request: bytes, context) -> bytes:
+        fields = {f: v for f, _w, v in iter_fields(request)}
+        cid = fields[1].decode()
+        state["requests"].append(cid)
+        assert fields.get(2) == 1, "client must set verbose=true"
+        if cid not in containers:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no such container")
+        return encode_container_status_response(
+            {"info": json.dumps({"pid": containers[cid],
+                                 "sandboxID": "s"})})
+
+    def make_handler(api):
+        return grpc.method_handlers_generic_handler(
+            f"{api}.RuntimeService",
+            {"ContainerStatus": grpc.unary_unary_rpc_method_handler(
+                container_status,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)})
+
+    server = grpc.server(
+        __import__("concurrent.futures", fromlist=["x"]).ThreadPoolExecutor(
+            max_workers=2))
+    server.add_generic_rpc_handlers((make_handler(state["api"]),))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield f"127.0.0.1:{port}", state
+    server.stop(None)
+
+
+def test_containerd_client_resolves_pid(cri_server):
+    target, state = cri_server
+    c = ContainerdClient(socket_path="/nonexistent", target=target)
+    assert c.pid_from_container_id("containerd://bbb222") == 4321
+    with pytest.raises(CRIError):
+        c.pid_from_container_id("containerd://nope")
+    with pytest.raises(CRIError):
+        c.pid_from_container_id("docker://bbb222")
+    c.close()
+
+
+def test_crio_client_falls_back_to_v1alpha2():
+    """A runtime serving only the v1alpha2 generation (what the reference
+    pins) must still resolve: the v1 call gets UNIMPLEMENTED and the
+    client retries on the older service name."""
+    import grpc
+    from concurrent.futures import ThreadPoolExecutor
+
+    def container_status(request: bytes, context) -> bytes:
+        return encode_container_status_response(
+            {"info": json.dumps({"pid": 77})})
+
+    server = grpc.server(ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            "runtime.v1alpha2.RuntimeService",
+            {"ContainerStatus": grpc.unary_unary_rpc_method_handler(
+                container_status,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)}),))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        c = CrioClient(socket_path="/nonexistent",
+                       target=f"127.0.0.1:{port}")
+        assert c.pid_from_container_id("cri-o://whatever") == 77
+        c.close()
+    finally:
+        server.stop(None)
+
+
+def test_resolver_dispatches_by_prefix(docker_sock):
+    calls = []
+
+    class Fake:
+        def __init__(self, pid):
+            self.pid = pid
+
+        def pid_from_container_id(self, cid):
+            calls.append(cid)
+            return self.pid
+
+        def close(self):
+            calls.append("closed")
+
+    r = CRIResolver(factories={
+        "docker": lambda: DockerClient(socket_path=docker_sock),
+        "containerd": lambda: Fake(7),
+    })
+    assert r.pid_from_container_id("docker://aaa111") == 1234
+    assert r.pid_from_container_id("containerd://x") == 7
+    assert r.pid_from_container_id("containerd://y") == 7  # client cached
+    with pytest.raises(CRIError):
+        r.pid_from_container_id("cri-o://z")  # no factory registered
+    r.close()
+    assert calls == ["containerd://x", "containerd://y", "closed"]
+
+
+def _fallback_fixture(cri, fs_extra=None):
+    from parca_agent_tpu.discovery.cgroup import CgroupContainerDiscoverer
+    from parca_agent_tpu.discovery.kubernetes import (
+        ContainerInfo,
+        PodDiscoverer,
+        PodInfo,
+    )
+    from parca_agent_tpu.utils.vfs import FakeFS
+
+    seen_cid = "a" * 64
+    racing_cid = "b" * 64
+    fs = FakeFS({
+        "/proc/10/cgroup": f"0::/kubepods/podx/{seen_cid}\n".encode(),
+        "/proc/10/comm": b"seen\n",
+        **(fs_extra or {}),
+    })
+    pods = [PodInfo(
+        name="p", namespace="ns", uid="u", node="n",
+        containers=(
+            ContainerInfo(name="seen", container_id=seen_cid,
+                          raw_id=f"containerd://{seen_cid}"),
+            ContainerInfo(name="racing", container_id=racing_cid,
+                          raw_id=f"containerd://{racing_cid}"),
+        ))]
+    d = PodDiscoverer(node="n", lister=lambda node: pods,
+                      cgroups=CgroupContainerDiscoverer(fs=fs),
+                      cri=cri)
+    return d, fs, racing_cid
+
+
+def test_resolver_keeps_channel_on_lookup_miss(docker_sock):
+    """Routine churn (engine 404) must not tear down a healthy client;
+    transport failure must evict it AND open the per-runtime circuit."""
+    built = []
+
+    def factory():
+        built.append(1)
+        return DockerClient(socket_path=docker_sock)
+
+    r = CRIResolver(factories={"docker": factory})
+    assert r.pid_from_container_id("docker://aaa111") == 1234
+    with pytest.raises(CRIError):
+        r.pid_from_container_id("docker://missing")  # 404: lookup miss
+    assert r.pid_from_container_id("docker://aaa111") == 1234
+    assert built == [1]  # one client for all three calls
+
+
+def test_resolver_circuit_breaker_on_transport_failure(tmp_path):
+    from parca_agent_tpu.discovery.cri import CRITransportError
+
+    built = []
+
+    def factory():
+        built.append(1)
+        # Socket path that doesn't exist: connect fails -> transport error
+        return DockerClient(socket_path=str(tmp_path / "absent.sock"))
+
+    r = CRIResolver(factories={"docker": factory}, breaker_ttl_s=30.0)
+    with pytest.raises(CRITransportError):
+        r.pid_from_container_id("docker://aaa111")
+    # Circuit open: the second resolution fails FAST without a redial.
+    with pytest.raises(CRITransportError):
+        r.pid_from_container_id("docker://bbb222")
+    assert built == [1]
+    r._broken_until.clear()  # TTL expiry
+    with pytest.raises(CRITransportError):
+        r.pid_from_container_id("docker://aaa111")
+    assert built == [1, 1]  # redialed with a freshly-probed client
+
+
+def test_pod_discoverer_cri_fallback_adopts_validated_pid():
+    """The scan/list race: a container that started after the cgroup
+    scan resolves through the CRI seam, and its pid is adopted because
+    the agent's /proc confirms that pid belongs to this container.
+    Containers the scan already saw never hit the runtime socket."""
+    asked = []
+    holder = {}
+
+    class FakeCRI:
+        def pid_from_container_id(self, cid):
+            asked.append(cid)
+            # Model the race: by the time the runtime answers, the
+            # container's process is visible in /proc.
+            d, fs, racing_cid = holder["fixture"]
+            fs.put("/proc/555/cgroup",
+                   f"0::/kubepods/podx/{racing_cid}\n".encode())
+            return 555
+
+    holder["fixture"] = _fallback_fixture(FakeCRI())
+    d, fs, racing_cid = holder["fixture"]
+    groups = {g.labels["container"]: g for g in d.scrape()}
+    assert groups["seen"].pids == [10]
+    assert groups["racing"].pids == [555]
+    assert asked == [f"containerd://{racing_cid}"]
+
+
+def test_pod_discoverer_cri_fallback_rejects_foreign_pid():
+    """A pid whose cgroup does not name the container (agent not in the
+    host pid namespace, or pid reuse) must be discarded, not labeled."""
+
+    class FakeCRI:
+        def pid_from_container_id(self, cid):
+            return 10  # exists, but belongs to the OTHER container
+
+    d, fs, racing_cid = _fallback_fixture(FakeCRI())
+    groups = {g.labels["container"]: g for g in d.scrape()}
+    assert "racing" not in groups
+    assert groups["seen"].pids == [10]
+
+
+def test_pod_discoverer_cri_negative_cache():
+    """Failed resolutions are not retried every poll: a dead runtime
+    socket costs one attempt per negative-cache TTL, not per scrape."""
+    calls = []
+
+    class FailingCRI:
+        def pid_from_container_id(self, cid):
+            calls.append(cid)
+            raise OSError("socket down")
+
+    d, fs, racing_cid = _fallback_fixture(FailingCRI())
+    d.scrape()
+    d.scrape()
+    assert len(calls) == 1  # second scrape hit the negative cache
+    d._cri_failed_until.clear()
+    d.scrape()
+    assert len(calls) == 2  # TTL expiry retries
