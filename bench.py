@@ -337,7 +337,14 @@ def run(emit=None) -> dict:
                 cm_query,
             )
 
-            ab_spec = CountMinSpec()
+            # Width scaled to the window the way an agent sizing its
+            # degradation sketch would: ~4 counters/unique keeps the CM
+            # collision term small at exactly the scale being A/B'd
+            # (a fixed default width would undersize 4x at 1M uniques
+            # and publish error numbers that measure the misconfiguration
+            # rather than the sketch).
+            ab_spec = CountMinSpec(
+                width=1 << max(18, (4 * rows - 1).bit_length()))
             h1 = hashes[0]
             t0 = time.perf_counter()
             cm = cm_build(h1, snap.counts.astype(np.int32), ab_spec)

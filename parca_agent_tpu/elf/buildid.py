@@ -2,8 +2,12 @@
 
 Precedence mirrors the reference:
   1. Go build id   — .note.go.buildid note (name "Go", type 4), the id the
-                     Go toolchain stamps (reference fastGoBuildID +
-                     internal/go/buildid fallback);
+                     Go toolchain stamps (reference fastGoBuildID), else
+                     the legacy text-segment magic scan (reference
+                     internal/go/buildid readRaw: pre-1.x toolchains and
+                     `go tool link -B none` binaries carry only the
+                     `\\xff Go build ID: "..."\\xff` marker at the start
+                     of text);
   2. GNU build id  — .note.gnu.build-id note (name "GNU", type 3), hex;
   3. fallback      — hash of .text contents, so stripped/noteless binaries
                      still get a stable identity.
@@ -18,6 +22,13 @@ from parca_agent_tpu.elf.reader import ElfFile
 NT_GNU_BUILD_ID = 3
 NT_GO_BUILD_ID = 4
 
+# Legacy in-text marker (internal/go/buildid/buildid.go:240-242): the id
+# is the quoted string between goBuildPrefix and goBuildEnd, stamped
+# within the first 32 kB of the text segment (readSize).
+_GO_MAGIC = b'\xff Go build ID: "'
+_GO_END = b'"\n \xff'
+_GO_SCAN_LIMIT = 32 * 1024
+
 
 def go_build_id(ef: ElfFile) -> str | None:
     sec = ef.section(".note.go.buildid")
@@ -28,6 +39,31 @@ def go_build_id(ef: ElfFile) -> str | None:
             if note.name == "Go" and note.type == NT_GO_BUILD_ID and note.desc:
                 return note.desc.rstrip(b"\x00").decode(errors="replace")
     return None
+
+
+def legacy_go_build_id(ef: ElfFile) -> str | None:
+    """Scan the head of the text segment for the legacy quoted marker
+    (internal/go/buildid readRaw semantics: the id is everything between
+    goBuildPrefix and the goBuildEnd terminator, no length cap). Only the
+    first 32 kB are examined (the toolchain stamps the marker at text
+    start and its own reader reads exactly that much), sliced without
+    materializing the whole section."""
+    sec = ef.section(".text")
+    if sec is None:
+        return None
+    end = min(sec.offset + min(sec.size, _GO_SCAN_LIMIT), len(ef.data))
+    data = ef.data[sec.offset:end]
+    i = data.find(_GO_MAGIC)
+    if i < 0:
+        return None
+    start = i + len(_GO_MAGIC)
+    j = data.find(_GO_END, start)
+    if j < 0:
+        return None
+    raw = data[start:j]
+    if not raw or b"\x00" in raw:
+        return None
+    return raw.decode(errors="replace")
 
 
 def gnu_build_id(ef: ElfFile) -> str | None:
@@ -50,4 +86,5 @@ def text_hash_id(ef: ElfFile) -> str | None:
 def build_id(data_or_elf) -> str | None:
     """Best-available build id for an ELF image (bytes or ElfFile)."""
     ef = data_or_elf if isinstance(data_or_elf, ElfFile) else ElfFile(data_or_elf)
-    return go_build_id(ef) or gnu_build_id(ef) or text_hash_id(ef)
+    return (go_build_id(ef) or legacy_go_build_id(ef) or gnu_build_id(ef)
+            or text_hash_id(ef))

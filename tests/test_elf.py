@@ -114,6 +114,53 @@ def test_build_id_fallback_is_text_hash():
     assert bid == text_hash_id(ef) and len(bid) == 40
 
 
+def _synth_elf_with_text(text: bytes) -> bytes:
+    """Minimal note-less ELF64 whose .text is the given bytes."""
+    import struct
+
+    shstrtab = b"\x00.text\x00.shstrtab\x00"
+    ehsize, shentsize = 64, 64
+    text_off = ehsize
+    shstr_off = text_off + len(text)
+    shoff = shstr_off + len(shstrtab)
+    hdr = b"\x7fELF" + bytes([2, 1, 1, 0]) + b"\x00" * 8
+    hdr += struct.pack("<HHIQQQIHHHHHH", 2, 0x3E, 1, 0, 0, shoff, 0,
+                       ehsize, 0, 0, shentsize, 3, 2)
+
+    def sh(name_off, typ, addr, off, size):
+        return struct.pack("<IIQQQQIIQQ", name_off, typ, 0, addr, off, size,
+                           0, 0, 1, 0)
+
+    shs = sh(0, 0, 0, 0, 0) + sh(1, 1, 0x1000, text_off, len(text)) + \
+        sh(7, 3, 0, shstr_off, len(shstrtab))
+    return hdr + text + shstrtab + shs
+
+
+def test_legacy_go_build_id_text_scan():
+    """Binaries without .note.go.buildid but with the in-text marker
+    (pre-note Go toolchains) resolve via the legacy scan, ahead of the
+    text-hash fallback (reference internal/go/buildid readRaw)."""
+    from parca_agent_tpu.elf.buildid import legacy_go_build_id
+
+    # The exact on-disk format the Go linker emits (goBuildPrefix +
+    # id + goBuildEnd, internal/go/buildid/buildid.go:240-242).
+    bid = "abc123_XYZ/4taIWoZ-unique/modulehash"
+    marker = b'\xff Go build ID: "' + bid.encode() + b'"\n \xff'
+    ef = ElfFile(_synth_elf_with_text(b"\x90" * 64 + marker + b"\x90" * 64))
+    assert legacy_go_build_id(ef) == bid
+    assert build_id(ef) == bid  # wins over text-hash fallback
+
+    # No marker -> None; wrong terminator (quote alone, the pre-fix bug
+    # shape) -> None; marker past the 32 kB scan window -> None (the
+    # toolchain stamps it at text start).
+    assert legacy_go_build_id(
+        ElfFile(_synth_elf_with_text(b"\x90" * 128))) is None
+    assert legacy_go_build_id(ElfFile(_synth_elf_with_text(
+        b'\xff Go build ID: "never-closed"\xff'))) is None
+    far = b"\x90" * (33 * 1024) + marker
+    assert legacy_go_build_id(ElfFile(_synth_elf_with_text(far))) is None
+
+
 def test_aslr_eligibility(fixtures):
     assert not is_aslr_eligible(fixtures["nopie"])
     assert is_aslr_eligible(fixtures["pie"])
