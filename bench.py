@@ -260,7 +260,9 @@ def run(emit=None) -> dict:
 
     _progress("warmup done; measuring steady-state")
     feed_times, close_times = [], []
+    phase_samples: dict[str, list[float]] = {}
     for _ in range(reps):
+        agg.timings.clear()  # drop stale entries (e.g. warmup feed_miss)
         t0 = time.perf_counter()
         for lo in range(0, rows, chunk):
             agg.feed(snap, hashes, lo, min(lo + chunk, rows))
@@ -268,9 +270,14 @@ def run(emit=None) -> dict:
         t0 = time.perf_counter()
         counts = agg.close_window()
         close_times.append(time.perf_counter() - t0)
+        for k, v in agg.timings.items():
+            phase_samples.setdefault(k, []).append(v)
         assert int(counts.sum()) == total
     tpu_ms = _median_ms(close_times)
-    phases = {k: round(v * 1e3, 2) for k, v in agg.timings.items()}
+    # Per-phase MEDIANS across reps (a single rep's snapshot mixes one
+    # slow tunnel transfer or a stale warmup value into the breakdown),
+    # plus the raw close reps so variance is visible in the artifact.
+    phases = {k: round(_median_ms(v), 2) for k, v in phase_samples.items()}
 
     _progress(f"steady-state done: close median {tpu_ms:.1f} ms")
     # Fully-synchronous one-shot boundary, for reference.
@@ -297,6 +304,7 @@ def run(emit=None) -> dict:
         "vs_baseline_sync": round(cpu_ms / sync_ms, 3),
         "backend": jax.default_backend(),
         "phases_ms": phases,
+        "close_reps_ms": [round(t * 1e3, 1) for t in close_times],
         "feed_window_ms": round(_median_ms(feed_times), 1),
         "sync_window_ms": round(sync_ms, 1),
         "cpu_rebuild_ms": round(cpu_ms, 1),

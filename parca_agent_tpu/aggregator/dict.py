@@ -163,17 +163,24 @@ def _feed_program(cap: int, id_cap: int, n_pad: int):
     return jax.jit(feed, donate_argnums=(1,))
 
 
-# Overflow sideband sizes for the packed close fetch: ids whose window
+# Overflow sideband caps for the packed close fetch: ids whose window
 # count exceeds the packing sentinel. The accumulator is NOT cleared by
 # close (it resets on the next window's first feed), so a sideband overrun
-# is recoverable: the host just re-runs close at a wider packing. Width 16
-# is the lossless backstop — any window total < 2^31 yields at most
-# 2^31/65535 = 32768 overflows, exactly its sideband size.
+# is recoverable: the host just re-runs close at a wider packing and/or a
+# larger sideband. Width 16 at the max sideband is the lossless backstop —
+# any window total < 2^31 yields at most 2^31/65535 = 32768 overflows,
+# exactly its max sideband size. The sideband actually FETCHED is sized
+# predictively from the previous window (stationary count distributions
+# make overflow populations stable), floored at _OVER_MIN — at the max
+# cap the sideband is 1/3 of the whole close buffer, so shipping only the
+# needed prefix is a real fraction of close latency on a thin link.
 _CLOSE_OVERS = {4: 1 << 15, 8: 1 << 15, 16: 1 << 15}
+_OVER_MIN = 1 << 12
 
 
-@functools.lru_cache(maxsize=12)
-def _close_program(id_cap: int, n_fetch: int, width: int):
+@functools.lru_cache(maxsize=24)
+def _close_program(id_cap: int, n_fetch: int, width: int,
+                   n_over_buf: int):
     """Window close: pack the accumulator's first n_fetch lanes to
     uint{width} (width 4 packs two counts per byte) with an exact
     (id, count) overflow sideband. The accumulator is left intact.
@@ -189,7 +196,6 @@ def _close_program(id_cap: int, n_fetch: int, width: int):
     import jax.numpy as jnp
 
     assert width in (4, 8, 16)
-    n_over_buf = _CLOSE_OVERS[width]
     sentinel = (1 << width) - 1
     per32 = 32 // width
 
@@ -292,6 +298,7 @@ class DictAggregator:
         self._fed_total = 0         # sample mass fed into the open window
         self._needs_reset = False   # first feed of next window clears acc
         self._prev_counts = None    # last closed window (width prediction)
+        self._prev_n_over = 0       # last close's overflow population
         self._pending: list[tuple[int, int]] = []  # host-side corrections
         self.stats = {"windows": 0, "inserts": 0, "overflow_misses": 0}
         self.timings: dict[str, float] = {}
@@ -436,22 +443,40 @@ class DictAggregator:
             n_fetch = min(self._id_cap,
                           max(grain, -(-self._next_id // grain) * grain))
             width = self._pick_close_width()
+            # Predictive sideband: cover 2x the previous window's overflow
+            # population (stationary distributions keep it stable), floored
+            # at _OVER_MIN; a misprediction is caught by the n_over counter
+            # and retried larger — never lossy.
+            predicted = max(_OVER_MIN, 2 * self._prev_n_over)
+            n_over_buf = min(_CLOSE_OVERS[width],
+                             1 << (predicted - 1).bit_length())
             t0 = _time.perf_counter()
             while True:
                 per32 = 32 // width
-                n_over_buf = _CLOSE_OVERS[width]
-                prog = _close_program(self._id_cap, n_fetch, width)
+                prog = _close_program(self._id_cap, n_fetch, width,
+                                      n_over_buf)
                 host = np.asarray(prog(self._acc))
                 n_over = int(host[-2])
                 if int(host[-1]) != 0:
                     raise AssertionError("count mass beyond fetched prefix")
                 if n_over <= n_over_buf:
                     break
-                # Sideband overran (width misprediction): acc is intact,
-                # go wider. Width 16 cannot overrun for int32 totals.
+                # Sideband overran: acc is intact, retry. Grow the buffer
+                # to cover the reported population first; only then go
+                # wider (width 16 at the max cap cannot overrun for int32
+                # totals).
                 self.stats["close_retries"] = \
                     self.stats.get("close_retries", 0) + 1
-                width = 8 if width == 4 else 16
+                if n_over <= _CLOSE_OVERS[width]:
+                    # The population fits this width: grow to cover it.
+                    n_over_buf = 1 << (n_over - 1).bit_length()
+                else:
+                    # Even the max sideband can't hold it: widening is
+                    # the only retry that can succeed — don't waste a
+                    # doomed max-cap fetch first.
+                    width = 8 if width == 4 else 16
+                    n_over_buf = _CLOSE_OVERS[width]
+            self._prev_n_over = n_over
             self.timings["close_fetch"] = _time.perf_counter() - t0
             t0 = _time.perf_counter()
             lanes_n = n_fetch // per32
@@ -570,6 +595,7 @@ class DictAggregator:
         self._dev = None
         self._acc = None
         self._prev_counts = None
+        self._prev_n_over = 0  # sideband prediction resets with it
         self.stats["rotations"] = self.stats.get("rotations", 0) + 1
 
     # -- internals ----------------------------------------------------------

@@ -289,6 +289,36 @@ def test_dict_streaming_width_misprediction_retries_lossless():
     assert set(np.unique(c2).tolist()) == {20}
 
 
+def test_dict_streaming_sideband_growth_retries_lossless():
+    """First close of a heavy-overflow window: the predictive sideband
+    starts at its floor (no history), the overflow population exceeds it,
+    and the retry grows the buffer (same width) against the intact
+    accumulator — exact counts, one retry, and the next window predicts
+    large enough to close in one fetch."""
+    import dataclasses
+
+    from parca_agent_tpu.aggregator.dict import _OVER_MIN
+
+    n = _OVER_MIN + 2048  # overflow population > the floor sideband
+    snap = generate(SyntheticSpec(n_pids=8, n_unique_stacks=n, n_rows=n,
+                                  total_samples=n, mean_depth=8, seed=33))
+    snap = dataclasses.replace(snap, counts=np.full(n, 16, np.int64))
+
+    d = DictAggregator(capacity=1 << 16)
+    d.window_counts(snap)  # stage population (inserts ride the host path)
+    d.feed(snap)
+    got = d.close_window()
+    assert d.stats.get("close_retries", 0) == 1
+    assert int(got.sum()) == 16 * n
+    assert set(np.unique(got).tolist()) == {16}
+    assert d._prev_n_over == n  # history: next close fetches once
+    d.feed(snap)
+    retries_before = d.stats["close_retries"]
+    got2 = d.close_window()
+    assert d.stats["close_retries"] == retries_before
+    assert int(got2.sum()) == 16 * n
+
+
 def test_dict_streaming_empty_close():
     d = DictAggregator(capacity=1 << 8)
     assert d.close_window().tolist() == []
