@@ -299,6 +299,10 @@ class DictAggregator:
         self._needs_reset = False   # first feed of next window clears acc
         self._prev_counts = None    # last closed window (width prediction)
         self._prev_n_over = 0       # last close's overflow population
+        # Keys at probe-chain positions >= _PROBES: device lookups can
+        # never find them, so feeds settle them host-side pre-ship.
+        self._unreachable: dict[tuple, int] = {}
+        self._unreach_h1: np.ndarray | None = None
         self._pending: list[tuple[int, int]] = []  # host-side corrections
         self.stats = {"windows": 0, "inserts": 0, "overflow_misses": 0}
         self.timings: dict[str, float] = {}
@@ -332,10 +336,12 @@ class DictAggregator:
             raise ValueError("window sample total exceeds int32")
         self._maybe_rotate()  # window boundary: safe to recycle cold ids
         h1, h2, h3 = hashes if hashes is not None else self.hash_rows(snapshot)
+        counts_f, corrections = self._prefilter_unreachable(
+            h1, h2, h3, snapshot.counts.astype(np.uint32))
         n_pad = 1 << max(4, (n - 1).bit_length())
         packed = np.zeros((4, n_pad), np.uint32)
         packed[0, :n], packed[1, :n], packed[2, :n] = h1, h2, h3
-        packed[3, :n] = snapshot.counts.astype(np.uint32)
+        packed[3, :n] = counts_f
 
         self._ensure_device()
         prog = _lookup_program(self._cap, self._id_cap, n_pad)
@@ -347,6 +353,8 @@ class DictAggregator:
         if n_miss:
             rows = np.asarray(miss_rows)[:n_miss]
             out = self._handle_misses(snapshot, rows, h1, h2, h3, out)
+        for sid, cnt in corrections:
+            out[sid] += cnt
         self.stats["windows"] += 1
         result = out[: self._next_id]
         self._last_seen[np.flatnonzero(result)] = self.stats["windows"]
@@ -381,12 +389,18 @@ class DictAggregator:
             self._maybe_rotate()
         h1, h2, h3 = hashes if hashes is not None else self.hash_rows(snapshot)
         t0 = _time.perf_counter()
+        counts_c, corrections = self._prefilter_unreachable(
+            h1[lo:hi], h2[lo:hi], h3[lo:hi],
+            snapshot.counts[lo:hi].astype(np.uint32))
+        # (corrections join _pending only after the device call succeeds,
+        # mirroring the miss path: a failed feed must not leave partial
+        # host-side mass that a recovery close would emit as a window.)
         n_pad = 1 << max(4, (n - 1).bit_length())
         packed = np.zeros((4, n_pad), np.uint32)
         packed[0, :n] = h1[lo:hi]
         packed[1, :n] = h2[lo:hi]
         packed[2, :n] = h3[lo:hi]
-        packed[3, :n] = snapshot.counts[lo:hi].astype(np.uint32)
+        packed[3, :n] = counts_c
         self.timings["feed_pack"] = _time.perf_counter() - t0
 
         self._ensure_device()
@@ -401,7 +415,11 @@ class DictAggregator:
                                       reset)
         self._acc = acc
         self._needs_reset = False
-        self._fed_total += chunk_total
+        self._pending.extend(corrections)
+        # _fed_total means "mass in the DEVICE accumulator" (the close
+        # gate and width prediction read it); host-settled corrections
+        # are not part of it.
+        self._fed_total += chunk_total - sum(c for _, c in corrections)
         nm = int(n_miss)  # device sync point
         self.timings["feed_dispatch"] = _time.perf_counter() - t0
         if nm:
@@ -576,6 +594,8 @@ class DictAggregator:
         new_map: dict[tuple, int] = {}
         self._occ[:] = False
         self._ids[:] = -1
+        self._unreachable = {}  # chains change wholesale with the rebuild
+        self._unreach_h1 = None
         for key, sid in self._key_to_id.items():
             nid = int(old_to_new[sid])
             if nid < 0:
@@ -585,6 +605,7 @@ class DictAggregator:
             self._occ[slot] = True
             self._h1[slot], self._h2[slot], self._h3[slot] = key
             self._ids[slot] = nid
+            self._mark_if_unreachable(key, slot, nid)
         self._key_to_id = new_map
         self._next_id = len(kept)
         # Per-pid registries with no surviving stacks go too (memory bound).
@@ -685,6 +706,7 @@ class DictAggregator:
             self._occ[slot] = True
             self._h1[slot], self._h2[slot], self._h3[slot] = key
             self._ids[slot] = sid
+            self._mark_if_unreachable(key, slot, sid)
             self._last_seen[sid] = self.stats["windows"] + 1
             new_slots.append(slot)
             new_rows.append(r)
@@ -710,11 +732,57 @@ class DictAggregator:
         # Capacity was validated batch-wide by _handle_misses.
         mask = self._cap - 1
         idx = key[0] & mask
-        # Unbounded on host (correctness); the device probe bound only
-        # causes overflow_misses, which the host path absorbs.
+        # Unbounded on host (correctness); a key landing beyond the device
+        # probe bound is recorded by the CALLER in _unreachable so later
+        # windows short-circuit it host-side instead of paying a
+        # device-miss fetch every feed.
         while self._occ[idx]:
             idx = (idx + 1) & mask
         return idx
+
+    def _chain_dist(self, key: tuple, slot: int) -> int:
+        mask = self._cap - 1
+        return (slot - (key[0] & mask)) & mask
+
+    def _mark_if_unreachable(self, key: tuple, slot: int, sid: int) -> None:
+        """Keys at probe-chain positions the device lookup cannot reach
+        (>= _PROBES) would miss on EVERY window — a fixed extra D2H fetch
+        plus host resolution per feed, forever. Register them so the feed
+        path settles them host-side before shipping."""
+        if self._chain_dist(key, slot) >= _PROBES:
+            self._unreachable[key] = sid
+            self._unreach_h1 = None  # sorted-cache invalidated
+
+    def _prefilter_unreachable(self, h1c, h2c, h3c, counts_c):
+        """Zero out rows whose keys the device probe bound cannot reach,
+        returning (filtered_counts, [(sid, count) corrections]). The
+        candidate scan is a sorted-array membership test on h1 (a few
+        dozen unreachable keys vs 100k+ rows), then exact-key
+        confirmation on the handful of candidates."""
+        if not self._unreachable:
+            return counts_c, []
+        if self._unreach_h1 is None:
+            self._unreach_h1 = np.sort(np.fromiter(
+                (k[0] for k in self._unreachable), np.uint32,
+                len(self._unreachable)))
+        pos = np.searchsorted(self._unreach_h1, h1c)
+        pos = np.minimum(pos, len(self._unreach_h1) - 1)
+        cand = np.flatnonzero((self._unreach_h1[pos] == h1c)
+                              & (counts_c > 0))
+        if not len(cand):
+            return counts_c, []
+        corrections = []
+        counts_c = counts_c.copy()
+        for r in map(int, cand):
+            sid = self._unreachable.get(
+                (int(h1c[r]), int(h2c[r]), int(h3c[r])))
+            if sid is not None:
+                corrections.append((sid, int(counts_c[r])))
+                counts_c[r] = 0
+        if corrections:
+            self.stats["unreachable_rows"] = \
+                self.stats.get("unreachable_rows", 0) + len(corrections)
+        return counts_c, corrections
 
     def _register_stacks_bulk(self, snapshot, rows: np.ndarray) -> None:
         """Vectorized per-pid location registration for a batch of newly

@@ -319,6 +319,56 @@ def test_dict_streaming_sideband_growth_retries_lossless():
     assert int(got2.sum()) == 16 * n
 
 
+def test_dict_unreachable_chain_short_circuits_host_side():
+    """Keys whose probe chain lands beyond the device bound would miss on
+    EVERY window (a fixed extra fetch per feed, forever). The host knows
+    the chain position at insert time, so later windows must settle those
+    rows pre-ship: exact counts, no recurring device misses."""
+    from parca_agent_tpu.aggregator.dict import _PROBES
+    from parca_agent_tpu.capture.formats import (
+        STACK_SLOTS,
+        MappingTable,
+        WindowSnapshot,
+    )
+
+    n = _PROBES + 8  # probe chain longer than the device bound
+    table = MappingTable(
+        pids=np.zeros(0, np.int32), starts=np.zeros(0, np.uint64),
+        ends=np.zeros(0, np.uint64), offsets=np.zeros(0, np.uint64),
+        objs=np.zeros(0, np.int32), obj_paths=(), obj_buildids=(),
+    )
+    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+    stacks[:, 0] = np.arange(1, n + 1, dtype=np.uint64) * 4096
+    counts = np.arange(1, n + 1, dtype=np.int64)
+    snap = WindowSnapshot(
+        pids=np.full(n, 3, np.int32), tids=np.full(n, 3, np.int32),
+        counts=counts, user_len=np.ones(n, np.int32),
+        kernel_len=np.zeros(n, np.int32), stacks=stacks, mappings=table,
+    )
+    # All keys collide on the table index: one linear chain of length n.
+    hashes = (np.full(n, 7, np.uint32),
+              np.arange(n, dtype=np.uint32),          # distinct identities
+              np.arange(100, 100 + n, dtype=np.uint32))
+
+    d = DictAggregator(capacity=1 << 10)
+    first = d.window_counts(snap, hashes)  # inserts; marks the deep tail
+    assert first.tolist() == counts.tolist()
+    assert len(d._unreachable) == n - _PROBES
+
+    # Steady state: the one-shot path and the streaming path both settle
+    # the deep tail host-side with exact counts and no device misses.
+    before = d.stats["overflow_misses"]
+    second = d.window_counts(snap, hashes)
+    assert second.tolist() == counts.tolist()
+    assert d.stats["overflow_misses"] == before
+    assert d.stats["unreachable_rows"] >= n - _PROBES
+
+    d.feed(snap, hashes)
+    got = d.close_window()
+    assert got.tolist() == counts.tolist()
+    assert d.stats["overflow_misses"] == before
+
+
 def test_dict_streaming_empty_close():
     d = DictAggregator(capacity=1 << 8)
     assert d.close_window().tolist() == []
