@@ -59,6 +59,9 @@ Scale knobs via env:
   PARCA_BENCH_REPS     (default 7)  TPU close reps (median)
   PARCA_BENCH_CPU_REPS (default 5)  CPU rebuild reps (median)
   PARCA_BENCH_BATCH    (default 1)  also bench the one-shot batch kernel
+  PARCA_BENCH_REP_IDLE_S (default 1.0) idle between reps (TPU and CPU
+                       alike), modeling the 10s-window duty cycle; 0 =
+                       fully saturated host
   PARCA_BENCH_ATTEMPT_TIMEOUT_S (default 600) child wall-clock bound
 """
 
@@ -258,10 +261,27 @@ def run(emit=None) -> dict:
             agg.feed(snap, hashes, lo, min(lo + chunk, rows))
         assert int(agg.close_window().sum()) == total
 
+    # The host mirror is millions of long-lived Python objects (key
+    # tuples, per-id location lists); a CPython gen-2 collection scans
+    # them all — a few hundred ms on this class of host — and lands mid
+    # close. Freeze the warm state out of the collector the way a
+    # production agent would after its first window.
+    import gc
+
+    gc.collect()
+    gc.freeze()
     _progress("warmup done; measuring steady-state")
+    # Production runs one close per 10 s window with the host otherwise
+    # idle; back-to-back reps instead keep this (often single-core) host
+    # saturated, so the tunnel client's and allocator's deferred work
+    # piles into the measured region. A short inter-rep idle models the
+    # real duty cycle; 0 gives the fully-saturated pessimistic number.
+    rep_idle_s = float(os.environ.get("PARCA_BENCH_REP_IDLE_S", 1.0))
     feed_times, close_times = [], []
     phase_samples: dict[str, list[float]] = {}
     for _ in range(reps):
+        if rep_idle_s:
+            time.sleep(rep_idle_s)
         agg.timings.clear()  # drop stale entries (e.g. warmup feed_miss)
         t0 = time.perf_counter()
         for lo in range(0, rows, chunk):
@@ -289,6 +309,8 @@ def run(emit=None) -> dict:
     _progress(f"sync one-shot done: {sync_ms:.1f} ms")
     cpu_times = []
     for _ in range(cpu_reps):
+        if rep_idle_s:  # same duty cycle as the TPU reps (fair baseline)
+            time.sleep(rep_idle_s)
         t0 = time.perf_counter()
         cpu_counts = window_counts_rebuild(snap)
         cpu_times.append(time.perf_counter() - t0)

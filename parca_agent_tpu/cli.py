@@ -312,6 +312,9 @@ def run(argv=None) -> int:
         debuginfo=debuginfo,
         duration_s=args.profiling_duration,
         on_iteration=on_iteration,
+        # The agent owns its process: steward GC so gen-2 pauses over the
+        # multi-million-object stack mirror never land mid-window.
+        manage_gc=True,
     )
 
     # -- HTTP ----------------------------------------------------------------

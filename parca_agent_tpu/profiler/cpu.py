@@ -62,6 +62,7 @@ class CPUProfiler:
         on_iteration: Callable[[int], None] | None = None,
         device_timeout_s: float = 60.0,
         device_retry_windows: int = 30,
+        manage_gc: bool = False,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -78,6 +79,10 @@ class CPUProfiler:
         self._writer = profile_writer
         self._debuginfo = debuginfo
         self._duration = duration_s
+        # Process-global GC stewardship (freeze + explicit boundary
+        # collects): only the process owner (the agent CLI) should turn
+        # this on; embedders keep CPython's default scheduler.
+        self._manage_gc_enabled = manage_gc
         self._on_iteration = on_iteration
         self._stop = threading.Event()
         self.metrics = ProfilerMetrics()
@@ -211,9 +216,40 @@ class CPUProfiler:
             self.metrics.errors_total += 1
             _log.warn("profile iteration failed", error=repr(e))
         self.metrics.last_attempt_duration_s = time.perf_counter() - t_start
+        self._manage_gc(self.metrics.attempts_total)
         if self._on_iteration is not None:
             self._on_iteration(self.metrics.attempts_total)
         return True
+
+    # CPython gen-2 collections scan every tracked object; the aggregator
+    # mirror holds millions of long-lived ones (stack-key tuples, per-id
+    # location lists), so an automatic pass costs hundreds of ms and can
+    # land in the middle of a window close (the Go reference never has
+    # this problem — its GC is concurrent). Policy: after the first
+    # window, freeze the warm state into the permanent generation
+    # (excluded from all collection) and DISABLE the automatic scheduler;
+    # instead collect explicitly here — a window boundary, nothing
+    # latency-sensitive in flight — where the tracked set is only what
+    # this window allocated plus registry growth since the last refreeze.
+    # Every _GC_REFREEZE windows (~1 h), unfreeze + full-collect +
+    # refreeze so garbage that slipped into the frozen set is reclaimed.
+    _GC_REFREEZE = 360
+
+    def _manage_gc(self, window: int) -> None:
+        if not self._manage_gc_enabled:
+            return
+        import gc
+
+        if window == 1:
+            gc.collect()
+            gc.freeze()
+            gc.disable()
+        elif window % self._GC_REFREEZE == 0:
+            gc.unfreeze()
+            gc.collect()
+            gc.freeze()
+        else:
+            gc.collect()
 
     def _write_profile(self, prof: PidProfile) -> None:
         labels = None

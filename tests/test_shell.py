@@ -58,6 +58,31 @@ def test_profiler_iteration_end_to_end():
     assert parsed.samples
 
 
+def test_profiler_gc_stewardship_opt_in():
+    """manage_gc=True (the agent CLI's setting) freezes the warm state and
+    disables the automatic scheduler after window 1, collecting explicitly
+    at boundaries instead; the default leaves process GC untouched."""
+    import gc
+
+    assert gc.isenabled()
+    p = CPUProfiler(source=ReplaySource([_snap(), _snap()]),
+                    aggregator=CPUAggregator(), manage_gc=True)
+    try:
+        assert p.run_iteration()
+        assert not gc.isenabled()  # explicit boundary collects from now on
+        assert p.run_iteration()
+        assert not gc.isenabled()
+    finally:
+        gc.unfreeze()
+        gc.enable()
+
+    # Default: no global side effects.
+    q = CPUProfiler(source=ReplaySource([_snap()]),
+                    aggregator=CPUAggregator())
+    assert q.run_iteration()
+    assert gc.isenabled()
+
+
 def test_profiler_fallback_on_device_failure():
     class Boom:
         name = "boom"
