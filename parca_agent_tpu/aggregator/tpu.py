@@ -170,13 +170,17 @@ def _window_kernel(
         out_slo.reshape(-1), mode="drop")
     fsrc = jnp.full((f_cap,), n * s, jnp.int32).at[tgt].set(
         jnp.arange(n * s, dtype=jnp.int32), mode="drop")
-    flive = jnp.zeros((f_cap,), bool).at[tgt].set(flat_live, mode="drop")
 
-    fpid_s, fhi_s, flo_s, flive_s, fidx = jax.lax.sort(
-        (fpid, fhi, flo, flive, fsrc),
+    fpid_s, fhi_s, flo_s, fidx = jax.lax.sort(
+        (fpid, fhi, flo, fsrc),
         num_keys=3,
         is_stable=True,
     )
+    # Liveness is derivable (dead f_cap slots carry the U32_MAX fill pid,
+    # real pids are int32-ranged), so it does not ride the sort — the
+    # f_cap-lane bitonic sort is this kernel's dominant cost and every
+    # dropped array is ~20% of its traffic.
+    flive_s = fpid_s != jnp.uint32(_U32_MAX)
 
     same_loc = (
         (fpid_s == _shift_down(fpid_s, jnp.uint32(_U32_MAX)))
@@ -276,6 +280,14 @@ def pack_window_inputs(snapshot: WindowSnapshot, l_cap: int | None = None):
     # upper bound on any merged group's sum) before the astype below wraps.
     if int(snapshot.counts.sum()) >= 2**31:
         raise ValueError("window sample total exceeds int32")
+    # The kernel uses pid == U32_MAX as its dead-row/dead-frame sentinel
+    # (liveness is derived from it, not carried through the sort). pid -1
+    # (perf's unattributable context) would alias it after the uint32
+    # cast and silently lose that profile — reject it loudly here; the
+    # capture layer attributes samples to real tgids.
+    if n and int(snapshot.pids.min()) < 0:
+        raise ValueError("negative pid in snapshot (would alias the "
+                         "kernel's dead-row sentinel)")
 
     pid = np.full(n_pad, _U32_MAX, np.uint32)
     pid[:n] = snapshot.pids.astype(np.uint32)
