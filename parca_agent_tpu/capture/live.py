@@ -189,6 +189,17 @@ def columns_to_snapshot(
     """Dedup identical (pid, tid, stack) rows into counted rows (the role
     the BPF stack_counts map plays in the reference). Columnar input from
     the native decoder or from records_to_snapshot's packing."""
+    pids = np.asarray(pids, np.int32)
+    if len(pids) and int(pids.min()) < 0:
+        # perf delivers unattributable/idle-context samples as pid -1;
+        # they carry no process to profile, and downstream the uint32
+        # cast would alias the device kernels' dead-row sentinel
+        # (aggregator/tpu.py pack guard). Drop the records, not the
+        # window.
+        keep = pids >= 0
+        pids, tids = pids[keep], np.asarray(tids)[keep]
+        ulen, klen = np.asarray(ulen)[keep], np.asarray(klen)[keep]
+        stacks = np.asarray(stacks)[keep]
     n = len(pids)
     if n == 0:
         return WindowSnapshot(
@@ -234,8 +245,11 @@ def records_to_snapshot(
     klen = np.zeros(n, np.int32)
     stacks = np.zeros((n, STACK_SLOTS), np.uint64)
     for i, (pid, tid, kframes, uframes) in enumerate(records):
-        pids[i] = pid
-        tids[i] = tid
+        # perf carries pid/tid as u32 (-1 = unattributable); store with
+        # int32 wraparound semantics like the native columnar decoder,
+        # so columns_to_snapshot's negative-pid drop sees them as -1.
+        pids[i] = pid if pid < 2**31 else pid - 2**32
+        tids[i] = tid if tid < 2**31 else tid - 2**32
         nu, nk = len(uframes), len(kframes)
         ulen[i] = nu
         klen[i] = nk

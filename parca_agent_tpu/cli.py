@@ -50,6 +50,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--aggregator-capacity", type=int, default=1 << 21,
                    help="dict table slots (power of two); dict+cm keeps "
                         "memory bounded at this size under stack churn")
+    p.add_argument("--fleet-coordinator", default="",
+                   help="host:port of fleet node 0; joining forms the "
+                        "cross-host device mesh (jax.distributed) and "
+                        "starts the per-window fleet merge actor: every "
+                        "window, all nodes reduce their stack streams "
+                        "over ICI/DCN collectives into fleet-wide "
+                        "sketches and exact unique-stack counts, served "
+                        "as parca_agent_fleet_* metrics "
+                        "(parallel/distributed.py; the offline "
+                        "cluster-wide pprof assembly is "
+                        "parallel/fleet.py fleet_merge_profiles)")
+    p.add_argument("--fleet-nodes", type=int, default=0,
+                   help="total agent processes in the fleet")
+    p.add_argument("--fleet-node-id", type=int, default=-1,
+                   help="this agent's rank (0 = coordinator)")
     p.add_argument("--capture", default="perf",
                    choices=["perf", "procfs", "synthetic", "replay"],
                    help="capture source: perf (native perf_event sampler, "
@@ -115,6 +130,19 @@ def run(argv=None) -> int:
 
     setup_logging(args.log_level)
     log = get_logger("cli")
+
+    # Fleet join must precede ANY jax backend touch (device probing in
+    # the aggregators below would pin a single-process backend).
+    if args.fleet_coordinator:
+        if args.fleet_nodes < 2 or not (0 <= args.fleet_node_id
+                                        < args.fleet_nodes):
+            log.error("--fleet-coordinator needs --fleet-nodes >= 2 and "
+                      "a valid --fleet-node-id")
+            return 2
+        from parca_agent_tpu.parallel.distributed import fleet_initialize
+
+        fleet_initialize(args.fleet_coordinator, args.fleet_nodes,
+                         args.fleet_node_id)
 
     from parca_agent_tpu.agent.batch import BatchWriteClient, NoopStoreClient
     from parca_agent_tpu.agent.listener import MatchingProfileListener
@@ -302,6 +330,24 @@ def run(argv=None) -> int:
         if args.windows and n >= args.windows:
             windows_done.set()
 
+    # -- fleet merge actor (multi-host mode) ---------------------------------
+    fleet_merger = None
+    window_sink = None
+    if args.fleet_coordinator:
+        from parca_agent_tpu.ops.hashing import row_hash_np
+        from parca_agent_tpu.parallel.distributed import FleetWindowMerger
+
+        fleet_merger = FleetWindowMerger(interval_s=args.profiling_duration)
+
+        def window_sink(snapshot):
+            # Hashing runs lazily on the fleet actor's thread, keeping
+            # the profiler's iteration free of the extra pass.
+            fleet_merger.submit_window(
+                lambda: row_hash_np(snapshot.stacks, snapshot.pids,
+                                    snapshot.user_len, snapshot.kernel_len,
+                                    n_hashes=2),
+                snapshot.counts)
+
     profiler = CPUProfiler(
         source=source,
         aggregator=aggregator,
@@ -315,6 +361,7 @@ def run(argv=None) -> int:
         # The agent owns its process: steward GC so gen-2 pauses over the
         # multi-million-object stack mirror never land mid-window.
         manage_gc=True,
+        window_sink=window_sink,
     )
 
     # -- HTTP ----------------------------------------------------------------
@@ -328,6 +375,15 @@ def run(argv=None) -> int:
         if hasattr(source, "truncated_drains"):
             out["parca_agent_capture_truncated_drains_total"] = \
                 source.truncated_drains
+        if fleet_merger is not None:
+            if fleet_merger.failed is not None:
+                # Fleet mode is dead (SPMD peer loss): surface THAT, not
+                # plausible frozen last-good gauges.
+                out["parca_agent_fleet_failed"] = 1
+            else:
+                out["parca_agent_fleet_failed"] = 0
+                out.update({f"parca_agent_{k}": v
+                            for k, v in fleet_merger.fleet_stats.items()})
         ws = getattr(source, "walk_stats", None)
         if ws is not None and ws.total:
             out["parca_agent_dwarf_walk_total"] = ws.total
@@ -367,6 +423,10 @@ def run(argv=None) -> int:
     threads.append(profiler_thread)
 
     stop = threading.Event()
+    if fleet_merger is not None:
+        threads.append(threading.Thread(
+            target=lambda: fleet_merger.run(stop), name="fleet",
+            daemon=True))
 
     def shutdown(*_a):
         stop.set()

@@ -1,0 +1,249 @@
+"""Multi-host fleet wiring: one agent process per node, real collectives.
+
+The single-process fleet path (parallel/fleet.py) models the cluster as
+rows of one host array — right for tests and for the driver dryrun. A
+real deployment runs one agent PROCESS per machine (the reference's
+DaemonSet pod, deploy/daemonset.yaml), and the cross-node reduction must
+ride the interconnect: `jax.distributed.initialize` forms the process
+group (coordinator = rank 0), after which `jax.devices()` spans every
+node and the same shard_map programs from fleet.py execute with their
+psum/pmax/all_gather lowered to cross-host collectives (Gloo on CPU,
+ICI/DCN on TPU pods — SURVEY.md section 5.8's "device mesh spanning
+hosts").
+
+Each process contributes exactly ONE mesh position (its primary device):
+the fleet axis is "one agent daemon = one node", not "one chip = one
+node". The wrappers here lift each node's LOCAL window stream into the
+global [n_nodes, R] array the fleet programs expect
+(host_local_array_to_global_array) and hand back fully-replicated
+results as host numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from parca_agent_tpu.parallel.fleet import (
+    FleetMergeSpec,
+    _check_streams,
+    _exact_program64,
+    _sketch_program,
+)
+from parca_agent_tpu.parallel.mesh import FLEET_AXIS
+from parca_agent_tpu.utils.log import get_logger
+
+log = get_logger("fleet")
+
+
+def fleet_initialize(coordinator_address: str, num_nodes: int,
+                     node_id: int) -> None:
+    """Join the fleet process group. Call once, before any device work.
+
+    On the CPU backend each process is pinned to one local device first:
+    the mesh convention is one position per agent, and an uninitialized
+    CPU backend would otherwise expose one device per core."""
+    import jax
+
+    # NOTE: nothing backend-touching may run before initialize() — even
+    # jax.process_count() would initialize XLA; is_initialized() is the
+    # one safe idempotence probe.
+    if jax.distributed.is_initialized():
+        return
+    try:
+        # On the CPU backend (dev fleets, tests) an uninitialized process
+        # would otherwise expose one device per core; on TPU the setting
+        # is ignored. Must happen before backend init.
+        jax.config.update("jax_num_cpu_devices", 1)
+    except Exception:  # noqa: BLE001 - backend already initialized
+        pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_nodes,
+        process_id=node_id,
+    )
+    log.info("fleet initialized", nodes=jax.process_count(),
+             node_id=node_id, devices=len(jax.devices()))
+
+
+def local_fleet_mesh():
+    """Mesh with ONE device per process along the node axis (each position
+    is one agent daemon). Requires an initialized process group."""
+    import jax
+    from jax.sharding import Mesh
+
+    n_proc = jax.process_count()
+    picked = {}
+    for d in jax.devices():
+        picked.setdefault(d.process_index, d)
+    if len(picked) != n_proc:
+        raise RuntimeError(
+            f"expected a device from each of {n_proc} processes, "
+            f"found {sorted(picked)}")
+    devs = [picked[i] for i in range(n_proc)]
+    return Mesh(np.asarray(devs), (FLEET_AXIS,))
+
+
+def _to_global(local_row: np.ndarray, mesh):
+    """Lift this node's [R] stream to the global [n_nodes, R] array."""
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    return multihost_utils.host_local_array_to_global_array(
+        local_row[None, :], mesh, P(FLEET_AXIS, None))
+
+
+def _check_fleet_total(local_counts: np.ndarray) -> None:
+    """SPMD analog of _check_streams' fleet-wide int32 bound: every node
+    contributes its local int64 mass, all nodes see the global sum, and
+    all raise together if the device lanes would wrap."""
+    from jax.experimental import multihost_utils
+
+    local = np.asarray([local_counts.astype(np.int64).sum()], np.int64)
+    fleet = multihost_utils.process_allgather(local, tiled=True)
+    if int(np.asarray(fleet).sum()) >= 2**31:
+        raise ValueError(
+            "fleet-wide sample total exceeds int32; merge hierarchically")
+
+
+def fleet_merge_sketches_dist(local_hashes, local_counts,
+                              spec=FleetMergeSpec(), mesh=None):
+    """Cluster-wide sketch merge from each node's LOCAL stream.
+
+    Every process calls this collectively with its own [R] hashes/counts
+    (R must match across nodes — pad with count-0 rows). Returns
+    (cm_table, hll_regs, total) identically on every node."""
+    local_hashes, local_counts = _check_streams(
+        np.asarray(local_hashes)[None, :], np.asarray(local_counts)[None, :])
+    _check_fleet_total(local_counts)
+    if mesh is None:
+        mesh = local_fleet_mesh()
+    prog = _sketch_program(mesh, spec)
+    cm, regs, totals = prog(_to_global(local_hashes[0], mesh),
+                            _to_global(local_counts[0], mesh))
+    from jax.experimental import multihost_utils
+
+    # cm/regs are replicated per node position; totals is one scalar per
+    # node — gather it so every node reports the fleet total.
+    total = int(np.asarray(
+        multihost_utils.process_allgather(totals, tiled=True)
+    ).astype(np.int64).sum())
+    cm_local = np.asarray(cm.addressable_shards[0].data[0])
+    regs_local = np.asarray(regs.addressable_shards[0].data[0])
+    return cm_local, regs_local, total
+
+
+def fleet_merge_exact64_dist(local_h1, local_h2, local_counts, mesh=None):
+    """Cluster-wide exact (hash64 -> count) merge from local streams.
+
+    Returns (h1, h2, counts) of the deduplicated fleet rows, identical on
+    every node (the all_gather-sort-segment program replicates them)."""
+    local_h1 = np.ascontiguousarray(local_h1, np.uint32)
+    local_h2 = np.ascontiguousarray(local_h2, np.uint32)
+    if local_h2.shape != local_h1.shape:
+        raise ValueError("local_h2 must be congruent with local_h1")
+    _, local_counts = _check_streams(
+        local_h1[None, :], np.asarray(local_counts)[None, :])
+    _check_fleet_total(local_counts)
+    if mesh is None:
+        mesh = local_fleet_mesh()
+    prog = _exact_program64(mesh)
+    r1, r2, sums, n_groups = prog(
+        _to_global(local_h1, mesh),
+        _to_global(local_h2, mesh),
+        _to_global(local_counts[0], mesh),
+    )
+    k = int(np.asarray(n_groups.addressable_shards[0].data)[0])
+    h1 = np.asarray(r1.addressable_shards[0].data[0])[:k]
+    h2 = np.asarray(r2.addressable_shards[0].data[0])[:k]
+    counts = np.asarray(sums.addressable_shards[0].data[0])[:k]
+    live = counts > 0  # padding groups (same contract as the local path)
+    return h1[live], h2[live], counts[live]
+
+
+def _agree_width(n_local: int) -> int:
+    """All nodes agree on the padded stream width for this round: the
+    fleet max, rounded to a power of two so the jitted programs see a
+    small set of shapes."""
+    from jax.experimental import multihost_utils
+
+    widths = multihost_utils.process_allgather(
+        np.asarray([n_local], np.int64), tiled=True)
+    w = max(64, int(np.asarray(widths).max()))
+    return 1 << (w - 1).bit_length()
+
+
+class FleetWindowMerger:
+    """The agent's runtime fleet actor: every `interval_s`, ALL nodes
+    rendezvous in one collective round and merge their most recent
+    window's compacted (h1, h2, count) stream into fleet-wide results.
+
+    SPMD discipline: collectives are a fixed program order all processes
+    must enter together, so a round NEVER skips — a node with no fresh
+    window contributes a zero-count stream (the identity of every
+    reduction used). A failure inside the collective is fatal to fleet
+    mode on every node at once (jax.distributed is SPMD; a lost process
+    means restart the fleet — the loss-tolerant channel to the Parca
+    server remains each node's own gRPC upload, exactly the reference's
+    transport). Results land in `fleet_stats` for /metrics:
+    fleet_total_samples, fleet_unique_stacks, fleet_rounds.
+    """
+
+    def __init__(self, interval_s: float = 10.0):
+        import threading
+
+        self._interval = interval_s
+        self._lock = threading.Lock()
+        self._window = None  # (hashes, counts) of the latest closed window
+        self.fleet_stats: dict = {}
+        self.failed: Exception | None = None
+
+    def submit_window(self, hashes, counts) -> None:
+        """Called after each window close. `hashes` is (h1, h2) row
+        streams — duplicates fine, the merge segment-sums them — or a
+        zero-arg callable returning them, so the hashing can run lazily
+        on THIS actor's thread instead of the profiler's hot path."""
+        with self._lock:
+            self._window = (hashes, np.ascontiguousarray(counts, np.int32))
+
+    def merge_round(self) -> None:
+        with self._lock:
+            win, self._window = self._window, None
+        if win is None:
+            h1 = h2 = np.zeros(0, np.uint32)
+            counts = np.zeros(0, np.int32)
+        else:
+            hashes, counts = win
+            h1, h2 = hashes() if callable(hashes) else hashes
+            h1 = np.ascontiguousarray(h1, np.uint32)
+            h2 = np.ascontiguousarray(h2, np.uint32)
+        width = _agree_width(len(h1))
+        ph1 = np.zeros(width, np.uint32)
+        ph2 = np.zeros(width, np.uint32)
+        pc = np.zeros(width, np.int32)
+        ph1[: len(h1)] = h1
+        ph2[: len(h2)] = h2
+        pc[: len(counts)] = counts
+        # ONE collective program per round: the exact merge already
+        # yields the fleet total (sum of merged counts) and the unique
+        # count; the sketch merge would add a second cross-host program
+        # for no extra information (sketches remain the offline/bounded
+        # artifact, parallel/fleet.py).
+        u1, _, uc = fleet_merge_exact64_dist(ph1, ph2, pc,
+                                             local_fleet_mesh())
+        self.fleet_stats = {
+            "fleet_total_samples": int(uc.astype(np.int64).sum()),
+            "fleet_unique_stacks": int(len(u1)),
+            "fleet_rounds": self.fleet_stats.get("fleet_rounds", 0) + 1,
+        }
+
+    def run(self, stop) -> None:
+        """Actor loop (threading.Event stop)."""
+        while not stop.is_set():
+            try:
+                self.merge_round()
+            except Exception as e:  # noqa: BLE001 - SPMD schedule broken
+                self.failed = e
+                log.error("fleet merge failed; fleet mode disabled",
+                          error=repr(e))
+                return
+            stop.wait(self._interval)

@@ -63,6 +63,7 @@ class CPUProfiler:
         device_timeout_s: float = 60.0,
         device_retry_windows: int = 30,
         manage_gc: bool = False,
+        window_sink: Callable[[WindowSnapshot], None] | None = None,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -83,6 +84,9 @@ class CPUProfiler:
         # collects): only the process owner (the agent CLI) should turn
         # this on; embedders keep CPython's default scheduler.
         self._manage_gc_enabled = manage_gc
+        # Optional tee of each window's snapshot (the fleet merger feeds
+        # on it); failures there must not fail the iteration.
+        self._window_sink = window_sink
         self._on_iteration = on_iteration
         self._stop = threading.Event()
         self.metrics = ProfilerMetrics()
@@ -207,6 +211,11 @@ class CPUProfiler:
                         pid = int(mt.pids[rows[0]])
                         objs.append((pid, path, bid))
                 self._debuginfo.ensure_uploaded(objs)
+            if self._window_sink is not None:
+                try:
+                    self._window_sink(snapshot)
+                except Exception as e:  # noqa: BLE001 - tee must not fail us
+                    _log.warn("window sink failed", error=repr(e))
             self.last_error = None
             _log.debug("window aggregated",
                        pids=len(profiles),
@@ -235,6 +244,20 @@ class CPUProfiler:
     # refreeze so garbage that slipped into the frozen set is reclaimed.
     _GC_REFREEZE = 360
 
+    _gc_modified = False
+
+    def _restore_gc(self) -> None:
+        """Undo the stewardship on shutdown: the process may outlive the
+        profiler (embedding tests, supervised restarts) and must get the
+        default collector back."""
+        if not self._gc_modified:
+            return
+        import gc
+
+        self._gc_modified = False
+        gc.unfreeze()
+        gc.enable()
+
     def _manage_gc(self, window: int) -> None:
         if not self._manage_gc_enabled:
             return
@@ -244,6 +267,7 @@ class CPUProfiler:
             gc.collect()
             gc.freeze()
             gc.disable()
+            self._gc_modified = True
         elif window % self._GC_REFREEZE == 0:
             gc.unfreeze()
             gc.collect()
@@ -285,6 +309,8 @@ class CPUProfiler:
             # treating thread death as a clean shutdown.
             self.crashed = e
             raise
+        finally:
+            self._restore_gc()
 
     crashed: BaseException | None = None
 

@@ -1,0 +1,122 @@
+"""Real multi-process fleet merge (parallel/distributed.py): two agent
+processes form a jax.distributed group over a localhost coordinator and
+run the fleet shard_map programs with TRUE cross-process collectives
+(Gloo on CPU — the DCN-path analog, SURVEY.md §5.8). The single-process
+fleet tests (test_fleet.py) cover the math; this covers the process
+boundary: initialization, one-device-per-process mesh, global-array
+lifting, and replicated results."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = r"""
+import json, sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+node_id, port = int(sys.argv[1]), sys.argv[2]
+
+from parca_agent_tpu.parallel.distributed import (
+    fleet_initialize,
+    fleet_merge_exact64_dist,
+    fleet_merge_sketches_dist,
+    local_fleet_mesh,
+)
+
+fleet_initialize(f"127.0.0.1:{port}", num_nodes=2, node_id=node_id)
+assert jax.process_count() == 2
+mesh = local_fleet_mesh()
+assert mesh.devices.size == 2
+
+# Per-node streams: rows 0..R-1 with node-dependent overlap so the merge
+# has both shared and private stacks.
+R = 64
+rng = np.random.default_rng(7)  # same seed: both nodes see the SAME pool
+pool_h1 = rng.integers(0, 2**32, 200, dtype=np.uint64).astype(np.uint32)
+pool_h2 = rng.integers(0, 2**32, 200, dtype=np.uint64).astype(np.uint32)
+idx = np.arange(R) + node_id * 32          # 32-row overlap between nodes
+h1, h2 = pool_h1[idx], pool_h2[idx]
+counts = np.full(R, node_id + 1, np.int32)  # node 0 adds 1, node 1 adds 2
+
+cm, regs, total = fleet_merge_sketches_dist(h1, counts)
+assert total == int(1 * R + 2 * R), total
+
+u1, u2, uc = fleet_merge_exact64_dist(h1, h2, counts)
+# Oracle: 32 shared rows count 3, 32+32 private rows count 1 / 2.
+key = (u1.astype(np.uint64) << np.uint64(32)) | u2.astype(np.uint64)
+assert len(u1) == 96, len(u1)
+assert int(uc.sum()) == total
+from collections import Counter
+assert Counter(uc.tolist()) == {3: 32, 1: 32, 2: 32}
+
+rounds = []
+from parca_agent_tpu.parallel.distributed import FleetWindowMerger
+
+merger = FleetWindowMerger(interval_s=0.0)
+# Round 1: both nodes have a window (reuse the streams above; widths
+# differ per node to exercise the fleet width agreement; lazy-callable
+# hashes exercise the off-hot-path contract).
+k = R - 8 * node_id
+merger.submit_window(lambda: (h1[:k], h2[:k]), counts[:k])
+merger.merge_round()
+rounds.append(dict(merger.fleet_stats))
+# Round 2: node 1 has NO fresh window -> contributes the zero stream;
+# the schedule must stay aligned and totals reflect node 0 only.
+if node_id == 0:
+    merger.submit_window((h1[:16], h2[:16]), counts[:16])
+merger.merge_round()
+rounds.append(dict(merger.fleet_stats))
+
+print(json.dumps({"node": node_id, "total": int(total),
+                  "uniques": int(len(u1)), "rounds": rounds}))
+"""
+
+
+def test_two_process_fleet_merge(tmp_path):
+    # Bounded by communicate(timeout=170) below; no plugin needed.
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # no forced multi-device CPU platform
+    # The worker script lives in tmp_path; APPEND the repo (keep the
+    # ambient path — it registers the device backend plugin).
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(i), str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env, cwd=repo)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=170)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:  # a failed peer must not leak its blocked sibling
+            if p.poll() is None:
+                p.kill()
+    assert {o["node"] for o in outs} == {0, 1}
+    # Replicated results: both nodes report the same fleet totals.
+    assert outs[0]["total"] == outs[1]["total"] == 192
+    assert outs[0]["uniques"] == outs[1]["uniques"] == 96
+    # Merger actor rounds agree fleet-wide. Round 1: node 0 contributed
+    # 64 rows of count 1, node 1 contributed 56 rows of count 2.
+    r0, r1_ = outs[0]["rounds"], outs[1]["rounds"]
+    assert r0 == r1_
+    assert r0[0]["fleet_total_samples"] == 64 * 1 + 56 * 2
+    assert r0[0]["fleet_rounds"] == 1
+    # Round 2: only node 0 had a fresh window (16 rows, count 1); node
+    # 1's zero stream is the reduction identity.
+    assert r0[1]["fleet_total_samples"] == 16
+    assert r0[1]["fleet_unique_stacks"] == 16
+    assert r0[1]["fleet_rounds"] == 2

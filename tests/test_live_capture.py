@@ -115,6 +115,28 @@ def test_records_to_snapshot_dedups():
     snap.validate_padding()
 
 
+def test_unattributable_records_dropped():
+    """perf's pid -1 (idle/unattributable context) records carry no
+    process to profile and would alias the device kernels' dead-row
+    sentinel after the uint32 cast: dropped record-by-record, never
+    failing the window."""
+    recs = decode_records(
+        _pack(7, 7, [], [0x401000]) * 2
+        + _pack(0xFFFFFFFF, 0xFFFFFFFF, [0xFFFF800000000010], []) * 3
+    )
+    snap = records_to_snapshot(recs, MappingTable.empty(), 10_000_000,
+                               10_000_000_000)
+    assert len(snap) == 1
+    assert snap.total_samples() == 2
+    assert int(snap.pids[0]) == 7
+
+    # An all-unattributable window degrades to an empty snapshot.
+    recs = decode_records(_pack(0xFFFFFFFF, 0, [], [0x1]) * 2)
+    snap = records_to_snapshot(recs, MappingTable.empty(), 10_000_000,
+                               10_000_000_000)
+    assert len(snap) == 0
+
+
 def test_empty_records():
     snap = records_to_snapshot([], MappingTable.empty(), 1, 1)
     assert len(snap) == 0
