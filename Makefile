@@ -1,0 +1,43 @@
+# Developer entry points (role of the reference's root Makefile:103-214:
+# build, split test targets, bench). The Python package itself needs no
+# build step; `native` compiles the perf sampler shared object.
+
+PYTHON ?= python
+
+.PHONY: all native test test-live bench fixtures golden clean install
+
+all: native
+
+native:
+	$(MAKE) -C parca_agent_tpu/native
+
+# Everything that runs without perf_event permission (the reference's
+# `make test` analog, Makefile:207-214). The split is by the registered
+# `live` pytest marker, not by name matching.
+test:
+	$(PYTHON) -m pytest tests/ -q -m "not live"
+
+# Kernel/permission-dependent capture tests (the reference runs these as
+# root, Makefile:204-205).
+test-live:
+	$(PYTHON) -m pytest tests/ -q -m live
+
+# The driver-scored benchmark: ONE JSON line on stdout.
+bench:
+	$(PYTHON) bench.py
+
+# Rebuild the checked-in ELF/DWARF test fixtures and their golden
+# unwind tables (the reference's write-dwarf-unwind-tables pattern,
+# Makefile:133-137).
+fixtures:
+	$(MAKE) -C tests/fixtures
+
+golden:
+	$(MAKE) -C tests/fixtures golden
+
+install:
+	$(PYTHON) -m pip install .
+
+clean:
+	$(MAKE) -C parca_agent_tpu/native clean 2>/dev/null || true
+	rm -rf build dist *.egg-info

@@ -152,6 +152,7 @@ def live_sampler():
     s.close()
 
 
+@pytest.mark.live
 def test_live_capture_smoke(live_sampler):
     """Real sampling: burn CPU for a window and expect our own samples."""
 
@@ -219,6 +220,7 @@ def test_decode_records_v2():
     assert len(decode_records_v2(buf + b"\x01" * 50)) == 2
 
 
+@pytest.mark.live
 def test_drain_overflow_is_lossless():
     """A drain buffer too small for the backlog must return what fits,
     keep the rest in the rings, and recover it on subsequent drains

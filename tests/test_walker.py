@@ -414,6 +414,7 @@ def test_fixture_unwind_table_covers_functions():
         assert idx >= 0, f"{fn} not covered"
 
 
+@pytest.mark.live
 def test_live_dwarf_capture_recovers_frameless_stacks():
     """End-to-end: sample a -fomit-frame-pointer fixture and recover its
     leaf->middle->outer->main chain via the DWARF walker (r1 VERDICT
