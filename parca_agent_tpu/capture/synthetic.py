@@ -34,6 +34,12 @@ class SyntheticSpec:
     kernel_fraction: float = 0.2     # fraction of rows with a kernel tail
     max_kernel_depth: int = 16
     mappings_per_pid: int = 4
+    # Function pool per object: location entropy knob. The default makes
+    # frame addresses near-unique per pid (every stack draws ~24 frames
+    # from 4096 functions but a pid owns only ~n_rows/n_pids stacks) —
+    # the adversarial case for location dedup. Small pools model real
+    # hosts, where a pid's hot frames repeat across most of its stacks.
+    n_funcs: int = 4096
     seed: int = 0
 
 
@@ -100,7 +106,7 @@ def generate(spec: SyntheticSpec) -> WindowSnapshot:
     ).astype(np.int32)
 
     # Frame addresses: a pool of "functions" per object; leaf-first.
-    n_funcs = 4096
+    n_funcs = spec.n_funcs
     func_off = (rng.integers(0, n_funcs, (spec.n_unique_stacks, STACK_SLOTS), dtype=np.uint64)
                 << np.uint64(8)) + np.uint64(0x40)
     which_obj = rng.integers(0, len(shared_base) + 1, (spec.n_unique_stacks, STACK_SLOTS))

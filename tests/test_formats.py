@@ -119,6 +119,27 @@ def test_synthetic_deterministic_and_valid():
             ), f"row {i} frame {j} addr {addr:#x} unmapped"
 
 
+def test_synthetic_n_funcs_controls_location_entropy():
+    """The n_funcs knob sets per-object function-pool size: small pools
+    model real hosts (a pid's hot frames repeat across its stacks),
+    large pools are the adversarial near-all-unique case for location
+    dedup (docs/perf.md batch_kernel_n_locs discussion)."""
+
+    def uniq_pid_frames(snap):
+        pids = np.repeat(snap.pids.astype(np.uint64), snap.stacks.shape[1])
+        frames = snap.stacks.reshape(-1)
+        live = frames != 0
+        return len(np.unique(
+            (pids[live] << np.uint64(1)) ^ frames[live] * np.uint64(3)))
+
+    base = dict(n_pids=50, n_unique_stacks=2000, total_samples=10000,
+                mean_depth=16, seed=5)
+    shared = generate(SyntheticSpec(n_funcs=16, **base))
+    advers = generate(SyntheticSpec(n_funcs=4096, **base))
+    assert uniq_pid_frames(shared) * 4 < uniq_pid_frames(advers)
+    shared.validate_padding()
+
+
 def test_synthetic_kernel_frames_live_high():
     a = generate(SyntheticSpec(n_pids=10, n_unique_stacks=100, kernel_fraction=1.0, seed=1))
     assert (a.kernel_len > 0).any()
