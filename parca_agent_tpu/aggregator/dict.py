@@ -286,10 +286,15 @@ class DictAggregator:
         self._ids = np.full(capacity, -1, np.int32)
         self._key_to_id: dict[tuple, int] = {}
         self._next_id = 0
-        # Per-id metadata (parallel lists, appended at insertion).
-        self._id_pid: list[int] = []
-        self._id_depth: list[int] = []
-        self._id_locs: list[np.ndarray] = []  # 1-based per-pid loc ids
+        # Per-id metadata, ragged numpy (appended at insertion): stack id i
+        # has pid _id_pid[i] and 1-based per-pid loc ids
+        # _loc_flat[_loc_off[i]:_loc_off[i+1]] (depth == run length). Flat
+        # arrays instead of a list-of-arrays: profile assembly and the
+        # window pprof encoder gather whole windows with single fancy
+        # indexes instead of per-id Python loops.
+        self._id_pid = np.empty(1024, np.int32)
+        self._loc_off = np.zeros(1025, np.int64)
+        self._loc_flat = np.empty(4096, np.int32)
         self._pids: dict[int, _PidRegistry] = {}
         # Device twin (created lazily; None until first window).
         self._dev = None
@@ -461,18 +466,18 @@ class DictAggregator:
             return 8
         return 16
 
-    def close_window(self) -> np.ndarray:
+    def close_window(self, copy: bool = True) -> np.ndarray:
         """Finish the open window: fetch exact int64 counts indexed by
         stack id (length == number of stacks known after this window).
 
         The device accumulator is kept until the next window's first feed,
         so a failed or mispredicted fetch can always be retried.
 
-        Buffer contract: the returned array is backed by a double-buffered
-        reusable allocation — it stays valid through the NEXT close and is
-        overwritten by the one after. Consumers (profile build, remote
-        write) finish within their own window, so nothing in-tree holds it
-        longer; copy if you must."""
+        Returns an owned copy by default. copy=False returns a view into a
+        double-buffered reusable allocation — valid through the NEXT close,
+        overwritten by the one after; only for callers that provably finish
+        with it within their own window (the bench's measured close does;
+        library consumers should take the default)."""
         import time as _time
 
         if self._fed_total == 0 and not self._pending:
@@ -556,7 +561,7 @@ class DictAggregator:
         out = counts[: self._next_id]
         self._last_seen[np.flatnonzero(out)] = self.stats["windows"]
         self._prev_counts = out
-        return out
+        return out.copy() if copy else out
 
     # -- bounded-memory degradation ------------------------------------------
 
@@ -619,9 +624,15 @@ class DictAggregator:
             return  # nothing cold yet; stay in sketch-degraded mode
         old_to_new = np.full(n, -1, np.int64)
         old_to_new[kept] = np.arange(len(kept))
-        self._id_pid = [self._id_pid[i] for i in kept]
-        self._id_depth = [self._id_depth[i] for i in kept]
-        self._id_locs = [self._id_locs[i] for i in kept]
+        # Compact the ragged per-id metadata to the survivors.
+        from parca_agent_tpu.pprof.vec import ragged_gather
+
+        off = self._loc_off
+        lens = off[kept + 1] - off[kept]
+        new_flat, new_off = ragged_gather(self._loc_flat, off[kept], lens)
+        self._id_pid = self._id_pid[:n][kept].copy()
+        self._loc_flat = new_flat
+        self._loc_off = new_off
         new_last = np.zeros(self._id_cap, np.int32)
         new_last[: len(kept)] = self._last_seen[kept]
         self._last_seen = new_last
@@ -644,7 +655,7 @@ class DictAggregator:
         self._key_to_id = new_map
         self._next_id = len(kept)
         # Per-pid registries with no surviving stacks go too (memory bound).
-        live_pids = set(self._id_pid)
+        live_pids = set(self._id_pid[: self._next_id].tolist())
         self._pids = {p: r for p, r in self._pids.items() if p in live_pids}
         # Device twin is rebuilt lazily from the host mirror; the open
         # accumulator is empty at a boundary; width prediction resets.
@@ -819,6 +830,31 @@ class DictAggregator:
                 self.stats.get("unreachable_rows", 0) + len(corrections)
         return counts_c, corrections
 
+    def _append_id_meta(self, pids: np.ndarray, depths: np.ndarray,
+                        flat_vals: np.ndarray) -> None:
+        """Append a batch of per-id metadata (pid, ragged loc-id runs whose
+        lengths are `depths`, concatenated in id order in `flat_vals`)."""
+        n = self._next_id - len(pids)  # ids were assigned before this call
+        need_ids = n + len(pids)
+        if need_ids > len(self._id_pid):
+            grown = np.empty(max(need_ids, 2 * len(self._id_pid)), np.int32)
+            grown[:n] = self._id_pid[:n]
+            self._id_pid = grown
+            goff = np.zeros(len(grown) + 1, np.int64)
+            goff[: n + 1] = self._loc_off[: n + 1]
+            self._loc_off = goff
+        self._id_pid[n:need_ids] = pids
+        base = int(self._loc_off[n])
+        np.cumsum(depths, out=self._loc_off[n + 1: need_ids + 1])
+        self._loc_off[n + 1: need_ids + 1] += base
+        need_flat = base + len(flat_vals)
+        if need_flat > len(self._loc_flat):
+            grown = np.empty(max(need_flat, 2 * len(self._loc_flat)),
+                             np.int32)
+            grown[:base] = self._loc_flat[:base]
+            self._loc_flat = grown
+        self._loc_flat[base:need_flat] = flat_vals
+
     def _register_stacks_bulk(self, snapshot, rows: np.ndarray) -> None:
         """Vectorized per-pid location registration for a batch of newly
         inserted stacks (the first window inserts everything — a python
@@ -828,9 +864,17 @@ class DictAggregator:
         table = snapshot.mappings
         # Batch outputs indexed by position in `rows` — positions correspond
         # 1:1 to the contiguous sids the caller just assigned, so the global
-        # per-id lists stay aligned with stack ids.
+        # per-id arrays stay aligned with stack ids. Each pid group's loc-id
+        # runs scatter straight into the ragged batch buffer (a dense
+        # [nb, STACK_SLOTS] staging matrix would be a ~0.5 GB transient on
+        # a 1M-insert first window).
+        from parca_agent_tpu.pprof.vec import ragged_gather
+
         nb = len(rows)
-        batch_locs: list = [None] * nb
+        depths64 = depths.astype(np.int64)
+        boff = np.zeros(nb + 1, np.int64)
+        np.cumsum(depths64, out=boff[1:])
+        flat_vals = np.empty(int(boff[-1]), np.int32)
 
         for pid in np.unique(pids):
             sel = np.flatnonzero(pids == pid)
@@ -899,29 +943,35 @@ class DictAggregator:
                     reg.addr_to_loc[a] = base + k + 1
 
             # Translate every frame to its 1-based loc id in one pass.
+            # stacks[live] selects row-major, so frame_ids is already the
+            # concatenation of this group's live prefixes in row order —
+            # scatter the runs to their batch-flat positions directly.
             lut = np.array([reg.addr_to_loc[int(a)] for a in uniq], np.int32)
             frame_ids = lut[np.searchsorted(uniq, stacks[live])]
-            id_rows = np.zeros((len(sel), STACK_SLOTS), np.int32)
-            id_rows[live] = frame_ids
-            for k, pos in enumerate(sel):
-                batch_locs[pos] = id_rows[k, : int(pdepths[k])].copy()
+            pd64 = pdepths.astype(np.int64)
+            src_starts = np.zeros(len(sel), np.int64)
+            np.cumsum(pd64[:-1], out=src_starts[1:])
+            ragged_gather(frame_ids, src_starts, pd64,
+                          out=flat_vals, out_starts=boff[sel])
 
-        self._id_pid.extend(int(p) for p in pids)
-        self._id_depth.extend(int(d) for d in depths)
-        self._id_locs.extend(batch_locs)
+        self._append_id_meta(pids.astype(np.int32), depths64, flat_vals)
 
     def _build_profiles(self, snapshot: WindowSnapshot,
                         counts: np.ndarray) -> list[PidProfile]:
+        from parca_agent_tpu.pprof.vec import ragged_gather
+
         ids = np.flatnonzero(counts)
         if not len(ids):
             return []
         vals = counts[ids]
-        id_pid = np.array(self._id_pid, np.int64)[ids]
+        id_pid = self._id_pid[: self._next_id].astype(np.int64)[ids]
         order = np.argsort(id_pid, kind="stable")
         ids, vals, id_pid = ids[order], vals[order], id_pid[order]
         bounds = np.flatnonzero(np.diff(id_pid)) + 1
         starts = np.concatenate(([0], bounds))
         ends = np.concatenate((bounds, [len(ids)]))
+        all_depths = (self._loc_off[ids + 1] - self._loc_off[ids]).astype(
+            np.int32)
 
         profiles = []
         for lo, hi in zip(starts, ends):
@@ -929,15 +979,15 @@ class DictAggregator:
             reg = self._pids[pid]
             sel = ids[lo:hi]
             s = len(sel)
-            depths = np.array([self._id_depth[i] for i in sel], np.int32)
+            depths = all_depths[lo:hi]
             loc_rows = np.zeros((s, STACK_SLOTS), np.int32)
-            for k, i in enumerate(sel):
-                row = self._id_locs[i]
-                loc_rows[k, : len(row)] = row
+            flat, _ = ragged_gather(self._loc_flat, self._loc_off[sel],
+                                    depths)
+            loc_rows[np.arange(STACK_SLOTS)[None, :] < depths[:, None]] = flat
             profiles.append(PidProfile(
                 pid=pid,
                 stack_loc_ids=loc_rows,
-                stack_depths=depths,
+                stack_depths=depths.copy(),
                 values=vals[lo:hi].copy(),
                 loc_address=np.array(reg.loc_address, np.uint64),
                 loc_normalized=np.array(reg.loc_normalized, np.uint64),

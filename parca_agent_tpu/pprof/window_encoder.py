@@ -1,0 +1,496 @@
+"""Vectorized window -> pprof bytes, paired with a DictAggregator.
+
+The "into pprof" half of the north star: after close_window() lands exact
+per-stack counts on the host, every pid with samples needs a serialized
+profile.proto. Done naively (per-sample scalar encode, builder.build_pprof)
+that is minutes per window at 50k-pid scale — far slower than the
+aggregation it follows. This encoder exploits the same stationarity the
+dict aggregator exploits for counts:
+
+  * Per-stack sample bytes are FIXED once the stack id exists: the packed
+    location-id field (tag + len + varints) never changes, because per-pid
+    location ids are registry-stable and append-only. They are encoded ONCE
+    at id sync (vectorized) and cached as one ragged uint8 buffer; a window
+    encode gathers the live ids' prefixes with a single fancy index and
+    splices in only the per-window count varints.
+  * Per-pid static sections (sample_type, mappings, locations, string
+    table, period) change only when that pid's registry grows; they are
+    cached as bytes and rebuilt incrementally (location growth appends to
+    the cached location section without touching the rest).
+
+Steady state — stationary stack population — therefore costs one ragged
+byte gather plus one varint pass over the live ids, independent of how the
+counts moved. And because a stationary population usually has the SAME
+live set window after window, the encoder goes one level further: count
+and time fields are serialized as fixed-width (non-minimal, legal) varints
+so the whole multi-hundred-MB window serialization has a value-independent
+layout, is cached as one buffer, and a repeat window is a vectorized patch
+of count varints — no re-serialization at all.
+
+Output matches builder.build_pprof for an unsymbolized profile (the
+reference agent also ships unsymbolized profiles and lets the server
+symbolize, pkg/profiler/pprof.go:24-72): same fields, same ids, same
+string-table construction; builder.parse_pprof round-trips it, and the
+differential tests assert sample-for-sample equality.
+
+Labels are NOT embedded per sample: they ride the write request beside the
+profile, exactly as the reference's batch writer carries them.
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+
+import numpy as np
+
+from parca_agent_tpu.pprof import proto
+from parca_agent_tpu.pprof.builder import (
+    LOC_ADDRESS,
+    LOC_ID,
+    LOC_MAPPING_ID,
+    M_BUILDID,
+    M_FILENAME,
+    M_ID,
+    M_LIMIT,
+    M_OFFSET,
+    M_START,
+    P_DURATION_NANOS,
+    P_LOCATION,
+    P_MAPPING,
+    P_PERIOD,
+    P_PERIOD_TYPE,
+    P_SAMPLE_TYPE,
+    P_STRING_TABLE,
+    P_TIME_NANOS,
+    VT_TYPE,
+    VT_UNIT,
+    _Strings,
+)
+from parca_agent_tpu.pprof.vec import (
+    put_varints,
+    put_varints_padded,
+    ragged_gather,
+    varint_len,
+)
+
+_TAG_SAMPLE = 0x12       # field 2 (Profile.sample), wire 2
+_TAG_S_LOCID = 0x0A      # field 1 (Sample.location_id), wire 2 (packed)
+_TAG_S_VALUE = 0x12      # field 2 (Sample.value), wire 2 (packed)
+_TAG_LOCATION = 0x22     # field 4 (Profile.location), wire 2
+
+
+def _encode_location_stream(ids: np.ndarray, mids: np.ndarray,
+                            addrs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized Profile.location messages for a flat stream of
+    (1-based id, mapping id, normalized address) rows (possibly many pids'
+    tables concatenated). Returns (uint8 buffer, int64 per-row offsets
+    [N+1]) so the caller can slice per-pid ranges."""
+    n = len(ids)
+    ids = np.ascontiguousarray(ids, np.uint64)
+    mids = np.ascontiguousarray(mids, np.uint64)
+    addrs = np.ascontiguousarray(addrs, np.uint64)
+    l_id = varint_len(ids)
+    l_mid = varint_len(mids)
+    l_addr = varint_len(addrs)
+    has_mid = mids > 0  # proto3 zero elision, as put_tag_varint does
+    body = (1 + l_id) + np.where(has_mid, 1 + l_mid, 0) + (1 + l_addr)
+    l_body = varint_len(body.astype(np.uint64))
+    msg = 1 + l_body + body
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(msg, out=offs[1:])
+    out = np.empty(int(offs[-1]), np.uint8)
+    p = offs[:-1]
+    out[p] = _TAG_LOCATION
+    put_varints(out, p + 1, body.astype(np.uint64), l_body)
+    p = p + 1 + l_body
+    out[p] = (LOC_ID << 3)
+    put_varints(out, p + 1, ids, l_id)
+    p = p + 1 + l_id
+    pm = p[has_mid]
+    out[pm] = (LOC_MAPPING_ID << 3)
+    put_varints(out, pm + 1, mids[has_mid], l_mid[has_mid])
+    p = p + np.where(has_mid, 1 + l_mid, 0)
+    out[p] = (LOC_ADDRESS << 3)
+    put_varints(out, p + 1, addrs, l_addr)
+    return out, offs
+
+
+class _PidStatic:
+    """Cached per-pid static sections of the profile message."""
+
+    __slots__ = ("head", "loc_bytes", "tail", "n_mappings", "n_locs",
+                 "period_ns")
+
+    def __init__(self):
+        self.head = b""          # sample_type + mapping messages
+        self.loc_bytes = bytearray()  # location messages (append-only)
+        self.tail = b""          # string table + period_type + period
+        self.n_mappings = -1
+        self.n_locs = 0
+        self.period_ns = -1      # period embedded in tail (staleness guard)
+
+
+class _Template:
+    """Cached whole-window serialization: every pid's profile bytes laid
+    out back to back in one uint8 buffer, with the positions of the only
+    per-window-variable bytes (fixed-width count varints and the shared
+    time/duration fields) recorded so the next window with the same live
+    stack set is a patch, not a re-serialization."""
+
+    __slots__ = ("buf", "idx", "pid_bounds", "pids", "val_pos",
+                 "time_pos", "static_gen", "period_ns")
+
+    def __init__(self):
+        self.buf = None          # np.uint8 big buffer
+        self.idx = None          # live stack ids this layout serves
+        self.pid_bounds = None   # int64 [G+1] blob boundaries in buf
+        self.pids = None         # int32 [G]
+        self.val_pos = None      # int64 [S] count-varint positions
+        self.time_pos = None     # int64 [G] per-pid time-field positions
+        self.static_gen = -1
+        self.period_ns = -1      # period the cached statics embed
+
+
+_WTAIL_LEN = 22  # [tag][10B time][tag][10B duration], fixed-width
+
+
+def _padded_bytes(v: int, width: int) -> np.ndarray:
+    """Fixed-width varint of one value as a uint8 array (see
+    vec.put_varints_padded for why non-minimal encodings are used)."""
+    out = np.empty(width, np.uint8)
+    vv = v & ((1 << 64) - 1)
+    for k in range(width):
+        b = (vv >> (7 * k)) & 0x7F
+        if k < width - 1:
+            b |= 0x80
+        out[k] = b
+    return out
+
+
+class WindowEncoder:
+    """Stateful encoder; reuse one instance per DictAggregator.
+
+    compress=True gzips each profile (local-store mode): the template is
+    still built and patched the same way, but every window pays a gzip
+    pass over the full output. The remote-write path ships raw protobuf
+    (the channel compresses) and skips that per-window cost."""
+
+    _VAL_W = 5    # fixed-width count varint: covers the int32 window bound
+    _TIME_W = 10  # fixed-width time/duration varint: covers any uint64
+
+    def __init__(self, agg, compress: bool = False):
+        self._agg = agg
+        self._compress = compress
+        self._synced = 0                 # ids with cached sample prefixes
+        self._rotations = -1             # aggregator rotation epoch mirror
+        self._pre_flat = np.empty(4096, np.uint8)
+        # _pre_off[0.._synced] are valid; capacity grows by doubling (a
+        # per-sync concatenate would re-copy ~8 MB of offsets per window
+        # at 1M ids just to append a trickle of new stacks).
+        self._pre_off = np.zeros(1024, np.int64)
+        self._order = None               # ids sorted by pid (int64)
+        self._order_pid = None           # pid per sorted slot (int32)
+        self._static: dict[int, _PidStatic] = {}
+        self._static_gen = 0             # bumps on any static rebuild
+        self._tmpl = _Template()
+        self.timings: dict[str, float] = {}
+
+    # -- mirrors -------------------------------------------------------------
+
+    def _sync(self) -> None:
+        """Bring the per-id sample-prefix cache and the pid sort order up to
+        the aggregator's current registry (cheap when nothing changed)."""
+        agg = self._agg
+        rot = agg.stats.get("rotations", 0)
+        if rot != self._rotations:
+            # Rotation remapped ids wholesale: drop every mirror.
+            self._rotations = rot
+            self._synced = 0
+            self._pre_off[0] = 0
+            self._static.clear()
+            self._static_gen += 1
+            self._order = None
+        n = agg._next_id
+        if n > self._synced:
+            self._extend_prefixes(self._synced, n)
+            self._synced = n
+            self._order = None
+        if self._order is None:
+            pids = agg._id_pid[:n].astype(np.int32, copy=False)
+            self._order = np.argsort(pids, kind="stable").astype(np.int64)
+            self._order_pid = pids[self._order]
+
+    def _extend_prefixes(self, s: int, n: int) -> None:
+        """Encode the fixed Sample prefix (location_id field) for ids
+        [s, n): one vectorized pass over all their frames."""
+        agg = self._agg
+        off = agg._loc_off
+        base = int(off[s])
+        frames = agg._loc_flat[base: int(off[n])].astype(np.uint64)
+        rel = (off[s: n + 1] - base).astype(np.int64)  # per-id frame offsets
+
+        fl = varint_len(frames)
+        cs = np.zeros(len(frames) + 1, np.int64)
+        np.cumsum(fl, out=cs[1:])
+        pb = cs[rel[1:]] - cs[rel[:-1]]          # packed body bytes per id
+        l_pb = varint_len(pb.astype(np.uint64))
+        pre = 1 + l_pb + pb                      # tag + len + packed ids
+        if n + 1 > len(self._pre_off):
+            grown = np.empty(max(n + 1, 2 * len(self._pre_off)), np.int64)
+            grown[: s + 1] = self._pre_off[: s + 1]
+            self._pre_off = grown
+        new_off = self._pre_off[s: n + 1]        # continue the cache tail
+        tail0 = int(new_off[0])
+        np.cumsum(pre, out=new_off[1:])
+        new_off[1:] += tail0
+
+        need = int(new_off[-1])
+        if need > len(self._pre_flat):
+            grown = np.empty(max(need, 2 * len(self._pre_flat)), np.uint8)
+            grown[:tail0] = self._pre_flat[:tail0]
+            self._pre_flat = grown
+        out = self._pre_flat
+        p = new_off[:-1]
+        out[p] = _TAG_S_LOCID
+        put_varints(out, p + 1, pb.astype(np.uint64), l_pb)
+        # Frame varints: frame k of id i lands at that id's body start plus
+        # the within-id byte cumsum.
+        depths = rel[1:] - rel[:-1]
+        body_start = p + 1 + l_pb
+        fpos = cs[:-1] + np.repeat(body_start - cs[rel[:-1]], depths)
+        put_varints(out, fpos, frames, fl)
+
+    # -- static sections -----------------------------------------------------
+
+    def _build_head_tail(self, st: _PidStatic, reg, period_ns: int) -> None:
+        """Rebuild the string-bearing sections (sample_type + mappings +
+        string table + period). Location ids/addresses carry no strings, so
+        the cached location section survives a mapping change (mapping ids
+        are registry-stable and append-only)."""
+        strings = _Strings()
+        w = proto.Writer()
+        vt = proto.Writer().varint(VT_TYPE, strings("samples")) \
+            .varint(VT_UNIT, strings("count"))
+        w.message(P_SAMPLE_TYPE, vt.buf)
+        for m in reg.mappings:
+            mw = (
+                proto.Writer()
+                .varint(M_ID, m.id)
+                .varint(M_START, m.start)
+                .varint(M_LIMIT, m.end)
+                .varint(M_OFFSET, m.offset)
+                .varint(M_FILENAME, strings(m.path))
+                .varint(M_BUILDID, strings(m.build_id))
+            )
+            w.message(P_MAPPING, mw.buf)
+        st.head = bytes(w.buf)
+        pt = proto.Writer().varint(VT_TYPE, strings("cpu")) \
+            .varint(VT_UNIT, strings("nanoseconds"))
+        tail = bytearray()
+        for s_ in strings.table:
+            proto.put_tag_bytes(tail, P_STRING_TABLE, s_.encode())
+        proto.put_tag_bytes(tail, P_PERIOD_TYPE, bytes(pt.buf))
+        proto.put_tag_varint(tail, P_PERIOD, period_ns)
+        st.tail = bytes(tail)
+        st.n_mappings = len(reg.mappings)
+        st.period_ns = period_ns
+        self._static_gen += 1
+
+    def _ensure_static(self, pid: int, period_ns: int) -> _PidStatic:
+        agg = self._agg
+        reg = agg._pids[pid]
+        st = self._static.get(pid)
+        if st is None:
+            st = self._static[pid] = _PidStatic()
+        if st.n_mappings != len(reg.mappings) or st.period_ns != period_ns:
+            self._build_head_tail(st, reg, period_ns)
+        n_locs = len(reg.loc_address)
+        if st.n_locs < n_locs:
+            ids = np.arange(st.n_locs + 1, n_locs + 1, dtype=np.uint64)
+            mids = np.asarray(reg.loc_mapping_id[st.n_locs:], np.uint64)
+            addrs = np.asarray(reg.loc_normalized[st.n_locs:], np.uint64)
+            buf, _ = _encode_location_stream(ids, mids, addrs)
+            st.loc_bytes.extend(buf.tobytes())
+            st.n_locs = n_locs
+            self._static_gen += 1
+        return st
+
+    def build_statics(self, period_ns: int) -> int:
+        """Pre-build every known pid's static sections in ONE vectorized
+        location pass (the per-pid _ensure_static path pays a vectorization
+        fixed cost per pid — ruinous for the 50k-pid first window). Returns
+        the number of pids now cached. Steady-state encodes then touch only
+        changed pids."""
+        self._sync()
+        agg = self._agg
+        dirty: list[tuple[_PidStatic, object, int]] = []
+        for pid, reg in agg._pids.items():
+            st = self._static.get(pid)
+            if st is None:
+                st = self._static[pid] = _PidStatic()
+            if st.n_mappings != len(reg.mappings) \
+                    or st.period_ns != period_ns:
+                self._build_head_tail(st, reg, period_ns)
+            if st.n_locs < len(reg.loc_address):
+                dirty.append((st, reg, len(reg.loc_address)))
+        if dirty:
+            ids = [np.arange(st.n_locs + 1, n + 1, dtype=np.uint64)
+                   for st, reg, n in dirty]
+            mids = [np.asarray(reg.loc_mapping_id[st.n_locs:], np.uint64)
+                    for st, reg, n in dirty]
+            addrs = [np.asarray(reg.loc_normalized[st.n_locs:], np.uint64)
+                     for st, reg, n in dirty]
+            lens = np.array([len(a) for a in ids], np.int64)
+            bounds = np.zeros(len(dirty) + 1, np.int64)
+            np.cumsum(lens, out=bounds[1:])
+            buf, offs = _encode_location_stream(
+                np.concatenate(ids), np.concatenate(mids),
+                np.concatenate(addrs))
+            mv = buf.data
+            for k, (st, reg, n) in enumerate(dirty):
+                st.loc_bytes.extend(
+                    mv[int(offs[bounds[k]]): int(offs[bounds[k + 1]])])
+                st.n_locs = n
+            self._static_gen += 1
+        return len(agg._pids)
+
+    # -- encode --------------------------------------------------------------
+
+    def _build_layout(self, idx: np.ndarray, pids_live: np.ndarray,
+                      period_ns: int) -> None:
+        """Serialize the full window layout (everything except the count and
+        time values, which are patched after) and record patch positions."""
+        tmpl = self._tmpl
+        bounds = np.flatnonzero(np.diff(pids_live)) + 1
+        gstarts = np.concatenate(([0], bounds))
+        gends = np.concatenate((bounds, [len(idx)]))
+        pids = pids_live[gstarts].astype(np.int32)
+        statics = [self._ensure_static(int(p), period_ns)
+                   for p in pids.tolist()]
+
+        pre_lens = self._pre_off[idx + 1] - self._pre_off[idx]
+        body_len = pre_lens + 2 + self._VAL_W
+        l_body = varint_len(body_len.astype(np.uint64))
+        samp_lens = 1 + l_body + body_len
+        stream_off = np.zeros(len(idx) + 1, np.int64)
+        np.cumsum(samp_lens, out=stream_off[1:])
+
+        static_lens = np.array(
+            [len(s.head) + len(s.loc_bytes) + len(s.tail) for s in statics],
+            np.int64)
+        gsizes = gends - gstarts
+        samples_per_g = stream_off[gends] - stream_off[gstarts]
+        blob_lens = samples_per_g + static_lens + _WTAIL_LEN
+        pid_bounds = np.zeros(len(pids) + 1, np.int64)
+        np.cumsum(blob_lens, out=pid_bounds[1:])
+
+        total = int(pid_bounds[-1])
+        buf = tmpl.buf
+        if buf is None or len(buf) < total:
+            buf = np.empty(int(total * 1.05) + 64, np.uint8)
+        # Each group's sample run starts at its blob start: shift the
+        # packed stream offsets group-wise.
+        shift = pid_bounds[:-1] - stream_off[gstarts]
+        p = stream_off[:-1] + np.repeat(shift, gsizes)
+        buf[p] = _TAG_SAMPLE
+        put_varints(buf, p + 1, body_len.astype(np.uint64), l_body)
+        ragged_gather(self._pre_flat, self._pre_off[idx], pre_lens,
+                      out=buf, out_starts=p + 1 + l_body)
+        vp = p + 1 + l_body + pre_lens
+        buf[vp] = _TAG_S_VALUE
+        buf[vp + 1] = self._VAL_W
+
+        time_pos = pid_bounds[:-1] + samples_per_g + static_lens
+        for g, s in enumerate(statics):
+            a = int(pid_bounds[g] + samples_per_g[g])
+            for part in (s.head, s.loc_bytes, s.tail):
+                lp = len(part)
+                if lp:
+                    buf[a: a + lp] = np.frombuffer(part, np.uint8)
+                    a += lp
+        buf[time_pos] = (P_TIME_NANOS << 3)
+        buf[time_pos + 1 + self._TIME_W] = (P_DURATION_NANOS << 3)
+
+        tmpl.buf = buf
+        tmpl.idx = idx.copy()
+        tmpl.pid_bounds = pid_bounds
+        tmpl.pids = pids
+        tmpl.val_pos = vp + 2
+        tmpl.time_pos = time_pos
+
+    def encode(self, counts: np.ndarray, time_ns: int, duration_ns: int,
+               period_ns: int, views: bool = False) -> list[tuple[int, bytes]]:
+        """Serialize one closed window: per-stack-id counts (as returned by
+        close_window/window_counts) -> [(pid, profile.proto bytes)].
+
+        views=True returns zero-copy memoryviews into the template buffer —
+        valid only until the next encode() call; for callers (bench, batch
+        writer) that consume within the window.
+        """
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._sync()
+        n = len(counts)
+        if n > self._synced:
+            raise ValueError("counts longer than the synced id space")
+        if n == self._synced:
+            order, order_pid = self._order, self._order_pid
+        else:
+            # Ids are dense 0..next_id; a shorter counts buffer (an older
+            # window) restricts to the ids it covers, keeping pid order.
+            keep = self._order < n
+            order, order_pid = self._order[keep], self._order_pid[keep]
+        counts_o = np.asarray(counts)[order]
+        live = counts_o > 0
+        idx = order[live]
+        vals = counts_o[live].astype(np.uint64)
+        pids_live = order_pid[live]
+        self.timings["encode_sync"] = _time.perf_counter() - t0
+        if not len(idx):
+            return []
+        if int(vals.max()) >= 1 << (7 * self._VAL_W):
+            raise ValueError("window count exceeds the fixed varint width")
+
+        tmpl = self._tmpl
+        t0 = _time.perf_counter()
+        hit = (tmpl.buf is not None
+               and tmpl.static_gen == self._static_gen
+               and tmpl.period_ns == period_ns
+               and tmpl.idx is not None
+               and len(tmpl.idx) == len(idx)
+               and bool(np.array_equal(tmpl.idx, idx)))
+        if not hit:
+            self._build_layout(idx, pids_live, period_ns)
+            tmpl.static_gen = self._static_gen  # statics built along the way
+            tmpl.period_ns = period_ns
+        buf = tmpl.buf
+        # Patch the per-window values (on a template hit this IS the encode).
+        put_varints_padded(buf, tmpl.val_pos, vals, self._VAL_W)
+        tp = tmpl.time_pos
+        w10 = np.arange(self._TIME_W, dtype=np.int64)
+        buf[tp[:, None] + 1 + w10[None, :]] = \
+            _padded_bytes(time_ns, self._TIME_W)[None, :]
+        buf[tp[:, None] + 2 + self._TIME_W + w10[None, :]] = \
+            _padded_bytes(duration_ns, self._TIME_W)[None, :]
+        self.timings["encode_patch" if hit else "encode_build"] = \
+            _time.perf_counter() - t0
+
+        t0 = _time.perf_counter()
+        pb = tmpl.pid_bounds
+        pid_list = tmpl.pids.tolist()
+        out: list[tuple[int, bytes]] = []
+        if self._compress:
+            mv = buf.data
+            for g, pid in enumerate(pid_list):
+                out.append((pid, _gzip.compress(
+                    bytes(mv[int(pb[g]): int(pb[g + 1])]), 1)))
+        elif views:
+            mv = buf.data
+            for g, pid in enumerate(pid_list):
+                out.append((pid, mv[int(pb[g]): int(pb[g + 1])]))
+        else:
+            for g, pid in enumerate(pid_list):
+                out.append((pid, buf[int(pb[g]): int(pb[g + 1])].tobytes()))
+        self.timings["encode_emit"] = _time.perf_counter() - t0
+        return out
