@@ -18,7 +18,10 @@ assembly" — is close_window(): one pack kernel + ONE packed fetch
 `sync_window_ms` reports the fully-synchronous one-shot path
 (window_counts) for the non-streaming boundary, with its own headline
 ratio `vs_baseline_sync` (= cpu_rebuild_ms / sync_window_ms) so the
-one-shot comparison is published alongside the streaming one.
+one-shot comparison is published alongside the streaming one. The
+`pprof` extras cover the OTHER half of the north star: the vectorized
+window->pprof encoder (template patch path) at full 50k-pid scale, with
+`window_to_pprof_ms` = close + encode as the full-boundary number.
 
 The baseline is the reference's architecture at the same boundary: its
 userspace re-deduplicates every stack of the window at close
@@ -42,7 +45,9 @@ budget): the parent process only supervises. The ENTIRE measurement runs
 in a child subprocess (PARCA_BENCH_CHILD=1) so backend init is paid
 exactly once per attempt and a hung init or hung dispatch is bounded by
 the child timeout (PARCA_BENCH_ATTEMPT_TIMEOUT_S). A failed/hung TPU
-child gets one fast retry; then the same measurement runs on the CPU
+child gets one fast retry (a SLOW failure means the backend is wedged
+and a retry would double the worst case); then the same measurement runs
+on the CPU
 backend (JAX_PLATFORMS=cpu) with the JSON line carrying an "error" field
 naming the device failure; if even that fails, a numpy-only measurement
 is printed in-process. The parent always prints ONE JSON line, exit 0.
@@ -62,7 +67,8 @@ Scale knobs via env:
   PARCA_BENCH_REP_IDLE_S (default 1.0) idle between reps (TPU and CPU
                        alike), modeling the 10s-window duty cycle; 0 =
                        fully saturated host
-  PARCA_BENCH_ATTEMPT_TIMEOUT_S (default 600) child wall-clock bound
+  PARCA_BENCH_PPROF    (default 1)  also bench the window->pprof encoder
+  PARCA_BENCH_ATTEMPT_TIMEOUT_S (default 900) child wall-clock bound
 """
 
 from __future__ import annotations
@@ -189,19 +195,25 @@ def _make_snapshot(rows: int, pids: int):
 
 def run(emit=None) -> dict:
     """The measurement. ``emit``, when set, is called with the headline
-    result dict as soon as the core numbers exist — BEFORE the optional
-    extras (A/B sketch, batch kernel) run. The r3 device attempt produced
-    a passing 121.9 ms close / 55x number and then hung compiling the
-    full-scale batch kernel through the tunnel, so the JSON line was
-    never printed and the attempt scored as a failure; the supervisor
-    already scans whatever stdout a hung child captured, so a flushed
-    provisional line makes the extras unable to lose the headline."""
+    result dict as soon as the core numbers exist — the instant the
+    steady-state closes and the (already-measured) CPU baseline give a
+    real vs_baseline, BEFORE the pprof/sync/extra phases run. The r3
+    device attempt produced a passing close number and then hung in a
+    later phase, so the JSON line was never printed and the attempt
+    scored as a failure; the supervisor scans whatever stdout a hung
+    child captured, so the early flushed line makes every later phase
+    unable to lose the headline. To the same end the CPU baseline
+    (numpy-only) runs FIRST, before any device compile, and the
+    population insert rides the feed path so only the feed+close
+    programs compile before the headline exists (the one-shot lookup
+    program compiles later, in the sync phase)."""
     extras: dict = {}
     rows = int(os.environ.get("PARCA_BENCH_ROWS", 1 << 20))
     pids = int(os.environ.get("PARCA_BENCH_PIDS", 50_000))
     reps = int(os.environ.get("PARCA_BENCH_REPS", 7))
     cpu_reps = int(os.environ.get("PARCA_BENCH_CPU_REPS", 5))
     bench_batch = os.environ.get("PARCA_BENCH_BATCH", "1") != "0"
+    bench_pprof = os.environ.get("PARCA_BENCH_PPROF", "1") != "0"
 
     import jax
 
@@ -227,8 +239,24 @@ def run(emit=None) -> dict:
     from parca_agent_tpu.aggregator.dict import DictAggregator
 
     snap = _make_snapshot(rows, pids)
+    total = snap.total_samples()
+    rep_idle_s = float(os.environ.get("PARCA_BENCH_REP_IDLE_S", 1.0))
 
     _progress(f"snapshot ready: {rows} rows, {pids} pids")
+    # CPU baseline FIRST: numpy-only, so the headline's vs_baseline exists
+    # before the device backend has compiled (or hung) anything.
+    cpu_times = []
+    for _ in range(cpu_reps):
+        if rep_idle_s:  # same duty cycle as the TPU reps (fair baseline)
+            time.sleep(rep_idle_s)
+        t0 = time.perf_counter()
+        cpu_counts = window_counts_rebuild(snap)
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_ms = _median_ms(cpu_times)
+    assert int(cpu_counts.sum()) == total
+    del cpu_counts
+
+    _progress(f"cpu rebuild done: {cpu_ms:.1f} ms")
     # Measure the tunnel's fixed round-trip (tiny compute + tiny fetch).
     tiny = jax.jit(lambda a: a + 1)
     x = jax.device_put(np.zeros(8, np.int32))
@@ -246,20 +274,22 @@ def run(emit=None) -> dict:
     cap = 1 << max(16, (4 * rows - 1).bit_length())
     agg = DictAggregator(capacity=cap, id_cap=cap // 2)
     hashes = agg.hash_rows(snap)
-    # First window: compiles the programs and inserts the stack population
-    # (one-time, capture-side-amortized in production).
-    _progress("first window (compile + insert population)")
-    counts = agg.window_counts(snap, hashes)
-    total = int(counts.sum())
-    assert total == snap.total_samples()
+    chunk = 1 << 17  # one capture drain's worth of rows per feed
+    # First window rides the FEED path (population insert through the
+    # feed-miss protocol): only the feed program compiles here, matching
+    # production (capture drains insert; the one-shot lookup program isn't
+    # needed until the sync phase, well after the headline).
+    _progress("first window (feed-path compile + insert population)")
+    for lo in range(0, rows, chunk):
+        agg.feed(snap, hashes, lo, min(lo + chunk, rows))
+    counts = agg.close_window(copy=False)
+    assert int(counts.sum()) == total
 
     _progress("first window done")
-    chunk = 1 << 17  # one capture drain's worth of rows per feed
-    # Warm both close widths (first close predicts from no history).
-    for _ in range(2):
-        for lo in range(0, rows, chunk):
-            agg.feed(snap, hashes, lo, min(lo + chunk, rows))
-        assert int(agg.close_window(copy=False).sum()) == total
+    # Warm the second close width (first close predicts from no history).
+    for lo in range(0, rows, chunk):
+        agg.feed(snap, hashes, lo, min(lo + chunk, rows))
+    assert int(agg.close_window(copy=False).sum()) == total
 
     # The host mirror is millions of long-lived Python objects (key
     # tuples, per-id location lists); a CPython gen-2 collection scans
@@ -274,9 +304,9 @@ def run(emit=None) -> dict:
     # Production runs one close per 10 s window with the host otherwise
     # idle; back-to-back reps instead keep this (often single-core) host
     # saturated, so the tunnel client's and allocator's deferred work
-    # piles into the measured region. A short inter-rep idle models the
-    # real duty cycle; 0 gives the fully-saturated pessimistic number.
-    rep_idle_s = float(os.environ.get("PARCA_BENCH_REP_IDLE_S", 1.0))
+    # piles into the measured region. A short inter-rep idle (rep_idle_s,
+    # set above) models the real duty cycle; 0 gives the fully-saturated
+    # pessimistic number.
     feed_times, close_times = [], []
     phase_samples: dict[str, list[float]] = {}
     for _ in range(reps):
@@ -303,35 +333,16 @@ def run(emit=None) -> dict:
     phases = {k: round(_median_ms(v), 2) for k, v in phase_samples.items()}
 
     _progress(f"steady-state done: close median {tpu_ms:.1f} ms")
-    # Fully-synchronous one-shot boundary, for reference.
-    t0 = time.perf_counter()
-    counts = agg.window_counts(snap, hashes)
-    sync_ms = (time.perf_counter() - t0) * 1e3
-    assert int(counts.sum()) == total
-
-    _progress(f"sync one-shot done: {sync_ms:.1f} ms")
-    cpu_times = []
-    for _ in range(cpu_reps):
-        if rep_idle_s:  # same duty cycle as the TPU reps (fair baseline)
-            time.sleep(rep_idle_s)
-        t0 = time.perf_counter()
-        cpu_counts = window_counts_rebuild(snap)
-        cpu_times.append(time.perf_counter() - t0)
-    cpu_ms = _median_ms(cpu_times)
-    assert int(cpu_counts.sum()) == total
-
-    _progress(f"cpu rebuild done: {cpu_ms:.1f} ms")
     result = {
         "metric": "steady_window_ms",
         "value": round(tpu_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / tpu_ms, 3),
-        "vs_baseline_sync": round(cpu_ms / sync_ms, 3),
         "backend": jax.default_backend(),
         "phases_ms": phases,
         "close_reps_ms": [round(t * 1e3, 1) for t in close_times],
+        "close_p90_ms": round(float(np.quantile(close_times, 0.9)) * 1e3, 1),
         "feed_window_ms": round(_median_ms(feed_times), 1),
-        "sync_window_ms": round(sync_ms, 1),
         "cpu_rebuild_ms": round(cpu_ms, 1),
         "cpu_reps": cpu_reps,
         "tunnel_rtt_ms": round(tunnel_rtt_ms, 1),
@@ -343,10 +354,11 @@ def run(emit=None) -> dict:
     if emit is not None:
         emit(result)
 
-    # Extras below enrich the line but must never lose it: each phase is
-    # skipped when the attempt budget is mostly spent (full-scale batch
-    # compile through the dev tunnel can exceed any remaining budget).
-    budget_s = float(os.environ.get("PARCA_BENCH_ATTEMPT_TIMEOUT_S", 600))
+    # Phases below enrich the line but must never lose it: each is skipped
+    # when the attempt budget is mostly spent (a full-scale compile through
+    # the dev tunnel can exceed any remaining budget), and the headline
+    # was already flushed above.
+    budget_s = float(os.environ.get("PARCA_BENCH_ATTEMPT_TIMEOUT_S", 900))
 
     def _budget_left(min_left_frac: float, what: str) -> bool:
         """True when at least min_left_frac of the attempt budget remains."""
@@ -356,6 +368,69 @@ def run(emit=None) -> dict:
         _progress(f"skipping {what}: {left:.0f}s of budget left")
         extras[f"{what}_skipped"] = f"budget: {left:.0f}s left"
         return False
+
+    def _emit_partial() -> None:
+        if emit is not None:
+            emit({**result, **extras})
+
+    # window->pprof: the OTHER half of the north star ("aggregate ... into
+    # pprof"). Steady state rides the encoder's template patch path (the
+    # stationary live set is exactly the production scenario); the one-time
+    # costs (static build, first layout) are published alongside.
+    if bench_pprof and _budget_left(0.25, "pprof"):
+        try:
+            from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+
+            enc = WindowEncoder(agg)
+            t0 = time.perf_counter()
+            n_built = enc.build_statics(snap.period_ns)
+            statics_ms = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+            out = enc.encode(counts, snap.time_ns, snap.window_ns,
+                             snap.period_ns)
+            first_ms = (time.perf_counter() - t0) * 1e3
+            out_bytes = sum(len(b) for _, b in out)
+            enc_times = []
+            for k in range(3):
+                if rep_idle_s:
+                    time.sleep(rep_idle_s)
+                t0 = time.perf_counter()
+                out = enc.encode(counts, snap.time_ns + k + 1,
+                                 snap.window_ns, snap.period_ns)
+                enc_times.append(time.perf_counter() - t0)
+            assert "encode_patch" in enc.timings  # template path engaged
+            pprof_ms = _median_ms(enc_times)
+            extras["pprof"] = {
+                "encode_ms": round(pprof_ms, 1),
+                "statics_build_ms": round(statics_ms, 1),
+                "first_encode_ms": round(first_ms, 1),
+                "profiles": len(out),
+                "bytes": out_bytes,
+                "pids_built": n_built,
+            }
+            # The full-boundary number the north star names: counts on
+            # host AND pprof bytes built, per window, steady state.
+            extras["window_to_pprof_ms"] = round(tpu_ms + pprof_ms, 1)
+            del out
+            _progress(f"pprof phase done: encode median {pprof_ms:.1f} ms")
+        except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+            extras["pprof_error"] = repr(e)[:200]
+        _emit_partial()
+
+    # Fully-synchronous one-shot boundary, for reference (compiles the
+    # lookup program — intentionally after the headline + pprof phases).
+    if _budget_left(0.15, "sync_oneshot"):
+        try:
+            t0 = time.perf_counter()
+            counts = agg.window_counts(snap, hashes)
+            sync_ms = (time.perf_counter() - t0) * 1e3
+            assert int(counts.sum()) == total
+            result["sync_window_ms"] = round(sync_ms, 1)
+            result["vs_baseline_sync"] = round(cpu_ms / sync_ms, 3)
+            _progress(f"sync one-shot done: {sync_ms:.1f} ms")
+        except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+            extras["sync_error"] = repr(e)[:200]
+        _emit_partial()
 
     # Exact-vs-count-min A/B at the full unique-stack scale (BASELINE
     # config #4): the sketch is the bounded-memory degradation mode
@@ -492,7 +567,7 @@ def main() -> None:
         _child_main()
         return
 
-    timeout_s = float(os.environ.get("PARCA_BENCH_ATTEMPT_TIMEOUT_S", 600))
+    timeout_s = float(os.environ.get("PARCA_BENCH_ATTEMPT_TIMEOUT_S", 900))
     errors: list[str] = []
     result: dict | None = None
 

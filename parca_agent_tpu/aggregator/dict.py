@@ -57,8 +57,11 @@ from parca_agent_tpu.ops.hashing import row_hash_np
 _PROBES = 16
 
 
-@functools.lru_cache(maxsize=4)
-def _lookup_program(cap: int, id_cap: int, n_pad: int):
+def make_lookup(cap: int, id_cap: int, n_pad: int):
+    """Pure (unjitted) batched-lookup window program; _lookup_program
+    jits it. (The driver entry point compile-checks make_feed, the same
+    probe loop with accumulate semantics; this one-shot variant is
+    exercised by the sync phase and its tests.)"""
     import jax
     import jax.numpy as jnp
 
@@ -107,13 +110,20 @@ def _lookup_program(cap: int, id_cap: int, n_pad: int):
         out = jnp.concatenate([counts, n_miss[None]])
         return out, miss_rows
 
-    return jax.jit(lookup, donate_argnums=())
+    return lookup
 
 
-@functools.lru_cache(maxsize=8)
-def _feed_program(cap: int, id_cap: int, n_pad: int):
-    """Streaming-window accumulate: like _lookup_program but scatter-adds
-    into a persistent device accumulator instead of a fresh counts buffer.
+@functools.lru_cache(maxsize=4)
+def _lookup_program(cap: int, id_cap: int, n_pad: int):
+    import jax
+
+    return jax.jit(make_lookup(cap, id_cap, n_pad), donate_argnums=())
+
+
+def make_feed(cap: int, id_cap: int, n_pad: int):
+    """Pure (unjitted) streaming-window accumulate: like make_lookup but
+    scatter-adds into a persistent device accumulator instead of a fresh
+    counts buffer.
 
     The TPU-native answer to the reference's in-kernel accumulation (its
     BPF stack_counts map absorbs samples DURING the window so window close
@@ -160,7 +170,14 @@ def _feed_program(cap: int, id_cap: int, n_pad: int):
         n_miss = miss.astype(jnp.int32).sum()
         return acc, n_miss, miss_rows
 
-    return jax.jit(feed, donate_argnums=(1,))
+    return feed
+
+
+@functools.lru_cache(maxsize=8)
+def _feed_program(cap: int, id_cap: int, n_pad: int):
+    import jax
+
+    return jax.jit(make_feed(cap, id_cap, n_pad), donate_argnums=(1,))
 
 
 # Overflow sideband caps for the packed close fetch: ids whose window

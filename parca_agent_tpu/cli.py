@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--aggregator-capacity", type=int, default=1 << 21,
                    help="dict table slots (power of two); dict+cm keeps "
                         "memory bounded at this size under stack churn")
+    p.add_argument("--fast-encode", action="store_true",
+                   help="dict aggregators only: serialize windows with the "
+                        "vectorized template encoder and ship profiles "
+                        "unsymbolized (the server symbolizes, as with the "
+                        "reference agent); disables local symbolization")
     p.add_argument("--fleet-coordinator", default="",
                    help="host:port of fleet node 0; joining forms the "
                         "cross-host device mesh (jax.distributed) and "
@@ -348,11 +353,14 @@ def run(argv=None) -> int:
                                     n_hashes=2),
                 snapshot.counts)
 
+    if args.fast_encode and not hasattr(aggregator, "window_counts"):
+        raise SystemExit("--fast-encode requires --aggregator dict/dict+cm")
     profiler = CPUProfiler(
         source=source,
         aggregator=aggregator,
         fallback_aggregator=fallback,
-        symbolizer=Symbolizer(ksym=KsymCache(), perf=PerfMapCache()),
+        symbolizer=(None if args.fast_encode
+                    else Symbolizer(ksym=KsymCache(), perf=PerfMapCache())),
         labels_manager=labels_mgr,
         profile_writer=writer,
         debuginfo=debuginfo,
@@ -362,6 +370,7 @@ def run(argv=None) -> int:
         # multi-million-object stack mirror never land mid-window.
         manage_gc=True,
         window_sink=window_sink,
+        fast_encode=args.fast_encode,
     )
 
     # -- HTTP ----------------------------------------------------------------

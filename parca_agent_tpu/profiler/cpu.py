@@ -64,9 +64,28 @@ class CPUProfiler:
         device_retry_windows: int = 30,
         manage_gc: bool = False,
         window_sink: Callable[[WindowSnapshot], None] | None = None,
+        fast_encode: bool = False,
     ):
         self._source = source
         self._aggregator = aggregator
+        # Fast write path: aggregate counts + vectorized template encoder,
+        # no per-pid PidProfile objects or scalar pprof serialization on
+        # the hot loop. Profiles ship unsymbolized (the reference agent's
+        # contract too — the server symbolizes), so it excludes a local
+        # symbolizer.
+        self._encoder = None
+        if fast_encode:
+            if symbolizer is not None:
+                raise ValueError(
+                    "fast_encode ships unsymbolized profiles; it cannot be "
+                    "combined with a local symbolizer")
+            if not hasattr(aggregator, "window_counts"):
+                raise ValueError(
+                    "fast_encode requires a dict-style aggregator "
+                    "(window_counts/close_window protocol)")
+            from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+
+            self._encoder = WindowEncoder(aggregator)
         self._fallback = fallback_aggregator
         self._device_timeout = device_timeout_s
         self._device_retry_windows = device_retry_windows
@@ -122,8 +141,14 @@ class CPUProfiler:
         return profiles
 
     def _aggregate_guarded(self, snapshot: WindowSnapshot):
+        return self._guarded(lambda: self._aggregator.aggregate(snapshot),
+                             lambda: self._fallback.aggregate(snapshot))
+
+    def _guarded(self, thunk, fallback_thunk):
+        """Run thunk on the device backend under the hang watchdog;
+        fallback_thunk on failure/hang (see _aggregate_guarded docs)."""
         if self._fallback is None:
-            return self._aggregator.aggregate(snapshot)
+            return thunk()
 
         if self._device_wedged_at is not None:
             # Device previously hung. Only retry after the cooldown and
@@ -131,7 +156,7 @@ class CPUProfiler:
             cooled = (self._windows_seen - self._device_wedged_at
                       >= self._device_retry_windows)
             if not (cooled and self._device_inflight.is_set()):
-                return self._fallback.aggregate(snapshot)
+                return fallback_thunk()
             self._device_wedged_at = None
             self._device_inflight = None
             _log.info("retrying device aggregation after cooldown")
@@ -145,7 +170,7 @@ class CPUProfiler:
 
         def call():
             try:
-                box["out"] = self._aggregator.aggregate(snapshot)
+                box["out"] = thunk()
             except BaseException as e:  # noqa: BLE001 - surfaced below
                 box["err"] = e
             finally:
@@ -168,7 +193,7 @@ class CPUProfiler:
                 aggregator=type(self._aggregator).__name__,
                 timeout_s=self._device_timeout,
                 retry_after_windows=self._device_retry_windows)
-        return self._fallback.aggregate(snapshot)
+        return fallback_thunk()
 
     def run_iteration(self) -> bool:
         """Returns False when the source is exhausted."""
@@ -190,16 +215,21 @@ class CPUProfiler:
         self.metrics.attempts_total += 1
         t_start = time.perf_counter()
         try:
-            profiles = self.obtain_profiles(snapshot)
-            self.metrics.samples_aggregated += snapshot.total_samples()
+            if self._encoder is not None:
+                n_pids = self._aggregate_encode_write(snapshot)
+            else:
+                profiles = self.obtain_profiles(snapshot)
+                self.metrics.samples_aggregated += snapshot.total_samples()
 
-            if self._symbolizer is not None:
-                t0 = time.perf_counter()
-                self._symbolizer.symbolize(profiles)
-                self.metrics.last_symbolize_duration_s = time.perf_counter() - t0
+                if self._symbolizer is not None:
+                    t0 = time.perf_counter()
+                    self._symbolizer.symbolize(profiles)
+                    self.metrics.last_symbolize_duration_s = \
+                        time.perf_counter() - t0
 
-            for prof in profiles:
-                self._write_profile(prof)
+                for prof in profiles:
+                    self._write_profile(prof)
+                n_pids = len(profiles)
 
             if self._debuginfo is not None:
                 objs = []
@@ -218,7 +248,7 @@ class CPUProfiler:
                     _log.warn("window sink failed", error=repr(e))
             self.last_error = None
             _log.debug("window aggregated",
-                       pids=len(profiles),
+                       pids=n_pids,
                        samples=int(snapshot.total_samples()))
         except Exception as e:  # non-fatal (cpu.go:326-330)
             self.last_error = e
@@ -275,23 +305,68 @@ class CPUProfiler:
         else:
             gc.collect()
 
-    def _write_profile(self, prof: PidProfile) -> None:
-        labels = None
+    def _labels_for(self, pid: int) -> dict | None:
+        """Label set for a pid; None when relabeling dropped the target."""
         if self._labels is not None:
-            labels = self._labels.label_set("parca_agent_cpu", prof.pid)
-            if labels is None:
-                self.process_last_errors[prof.pid] = None
-                return  # relabeling dropped this target
+            return self._labels.label_set("parca_agent_cpu", pid)
+        return {"__name__": "parca_agent_cpu", "pid": str(pid)}
+
+    def _write_profile(self, prof: PidProfile) -> None:
+        labels = self._labels_for(prof.pid)
         if labels is None:
-            labels = {"__name__": "parca_agent_cpu", "pid": str(prof.pid)}
+            self.process_last_errors[prof.pid] = None
+            return  # relabeling dropped this target
         try:
             if self._writer is not None:
-                self._writer.write(labels, build_pprof(prof))
+                # compress=False: the writer owns gzip framing (gzipping
+                # here too double-compressed every profile).
+                self._writer.write(labels, build_pprof(prof, compress=False))
             self.metrics.profiles_written += 1
             self.process_last_errors[prof.pid] = None
         except Exception as e:
             self.process_last_errors[prof.pid] = e
             raise
+
+    def _aggregate_encode_write(self, snapshot: WindowSnapshot) -> int:
+        """Fast path: counts -> vectorized encoder -> writer, no PidProfile
+        materialization. The device call rides the same hang watchdog as
+        the classic path; on failure/hang the CPU fallback aggregates and
+        writes through the scalar builder."""
+        t0 = time.perf_counter()
+        self._windows_seen += 1  # hang-cooldown clock (obtain_profiles' twin)
+
+        def fast():
+            counts = self._aggregator.window_counts(snapshot)
+            return "enc", self._encoder.encode(
+                counts, snapshot.time_ns, snapshot.window_ns,
+                snapshot.period_ns)
+
+        def fallback():
+            return "prof", self._fallback.aggregate(snapshot)
+
+        kind, out = self._guarded(fast, fallback)
+        self.metrics.last_aggregate_duration_s = time.perf_counter() - t0
+        self.metrics.samples_aggregated += snapshot.total_samples()
+        if kind == "prof":
+            for prof in out:
+                self._write_profile(prof)
+            return len(out)
+        n = 0
+        for pid, blob in out:
+            labels = self._labels_for(pid)
+            if labels is None:
+                self.process_last_errors[pid] = None
+                continue
+            try:
+                if self._writer is not None:
+                    self._writer.write(labels, blob)
+                self.metrics.profiles_written += 1
+                self.process_last_errors[pid] = None
+                n += 1
+            except Exception as e:
+                self.process_last_errors[pid] = e
+                raise
+        return n
 
     # -- actor --------------------------------------------------------------
 

@@ -58,6 +58,67 @@ def test_profiler_iteration_end_to_end():
     assert parsed.samples
 
 
+def test_profiler_fast_encode_matches_classic_path():
+    """fast_encode writes the same profile content as the classic
+    per-PidProfile path (parsed-message equality per pid), minus
+    gzip framing."""
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+    from parca_agent_tpu.pprof.builder import parse_pprof
+
+    snap = _snap(seed=3)
+    w_classic = CollectingWriter()
+    CPUProfiler(source=ReplaySource([snap]), aggregator=CPUAggregator(),
+                profile_writer=w_classic).run_iteration()
+
+    w_fast = CollectingWriter()
+    p = CPUProfiler(source=ReplaySource([snap]),
+                    aggregator=DictAggregator(capacity=1 << 10),
+                    profile_writer=w_fast, fast_encode=True)
+    assert p.run_iteration()
+    assert p.last_error is None
+    assert p.metrics.profiles_written == len(w_classic.profiles)
+
+    classic = {l["pid"]: parse_pprof(b) for l, b in w_classic.profiles}
+    for labels, blob in w_fast.profiles:
+        want = classic[labels["pid"]]
+        have = parse_pprof(blob)
+        assert have.stacks_by_address() == want.stacks_by_address()
+        assert have.period == want.period
+
+
+def test_profiler_fast_encode_rejects_symbolizer():
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+
+    class Sym:
+        def symbolize(self, profiles):
+            pass
+
+    with pytest.raises(ValueError):
+        CPUProfiler(source=ReplaySource([]),
+                    aggregator=DictAggregator(capacity=1 << 10),
+                    symbolizer=Sym(), fast_encode=True)
+    with pytest.raises(ValueError):
+        CPUProfiler(source=ReplaySource([]), aggregator=CPUAggregator(),
+                    fast_encode=True)
+
+
+def test_profiler_fast_encode_fallback_on_device_failure():
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+
+    class BoomDict(DictAggregator):
+        def window_counts(self, snapshot, hashes=None):
+            raise RuntimeError("device gone")
+
+    w = CollectingWriter()
+    p = CPUProfiler(source=ReplaySource([_snap(seed=4)]),
+                    aggregator=BoomDict(capacity=1 << 10),
+                    fallback_aggregator=CPUAggregator(),
+                    profile_writer=w, fast_encode=True)
+    assert p.run_iteration()
+    assert p.last_error is None
+    assert len(w.profiles) == 5  # fallback wrote via the scalar builder
+
+
 def test_profiler_gc_stewardship_opt_in():
     """manage_gc=True (the agent CLI's setting) freezes the warm state and
     disables the automatic scheduler after window 1, collecting explicitly
