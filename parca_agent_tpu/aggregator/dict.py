@@ -195,10 +195,9 @@ _CLOSE_OVERS = {4: 1 << 15, 8: 1 << 15, 16: 1 << 15}
 _OVER_MIN = 1 << 12
 
 
-@functools.lru_cache(maxsize=24)
-def _close_program(id_cap: int, n_fetch: int, width: int,
-                   n_over_buf: int):
-    """Window close: pack the accumulator's first n_fetch lanes to
+def make_close(id_cap: int, n_fetch: int, width: int,
+               n_over_buf: int):
+    """Pure (unjitted) window close: pack the accumulator's first n_fetch lanes to
     uint{width} (width 4 packs two counts per byte) with an exact
     (id, count) overflow sideband. The accumulator is left intact.
 
@@ -236,7 +235,15 @@ def _close_program(id_cap: int, n_fetch: int, width: int,
             lanes, over_id, over_val, n_over[None], tail_total[None]])
         return out
 
-    return jax.jit(close)
+    return close
+
+
+@functools.lru_cache(maxsize=24)
+def _close_program(id_cap: int, n_fetch: int, width: int,
+                   n_over_buf: int):
+    import jax
+
+    return jax.jit(make_close(id_cap, n_fetch, width, n_over_buf))
 
 
 @dataclasses.dataclass
@@ -377,9 +384,7 @@ class DictAggregator:
         packed[3, :n] = counts_f
 
         self._ensure_device()
-        prog = _lookup_program(self._cap, self._id_cap, n_pad)
-        dev_out, miss_rows = prog(self._dev, jnp.asarray(packed))
-        host_out = np.asarray(dev_out)
+        host_out, miss_rows = self._lookup_dispatch(packed, n_pad)
         n_miss = int(host_out[-1])
         out = host_out[:-1].astype(np.int64)
 
@@ -444,29 +449,63 @@ class DictAggregator:
 
         self._ensure_device()
         if self._acc is None:
-            self._acc = jnp.zeros(self._id_cap, jnp.int32)
-        prog = _feed_program(self._cap, self._id_cap, n_pad)
+            self._acc = self._new_acc()
         t0 = _time.perf_counter()
-        acc = self._acc
-        self._acc = None  # donated: invalid if the call throws
-        reset = jnp.uint32(1 if self._needs_reset else 0)
-        acc, n_miss, miss_rows = prog(self._dev, acc, jnp.asarray(packed),
-                                      reset)
-        self._acc = acc
+        miss_rel = self._feed_dispatch(packed, n_pad,
+                                       1 if self._needs_reset else 0)
         self._needs_reset = False
         self._pending.extend(corrections)
         # _fed_total means "mass in the DEVICE accumulator" (the close
         # gate and width prediction read it); host-settled corrections
         # are not part of it.
         self._fed_total += chunk_total - sum(c for _, c in corrections)
-        nm = int(n_miss)  # device sync point
         self.timings["feed_dispatch"] = _time.perf_counter() - t0
-        if nm:
+        if len(miss_rel):
             t0 = _time.perf_counter()
-            rows = np.asarray(miss_rows)[:nm].astype(np.int64) + lo
+            rows = miss_rel.astype(np.int64) + lo
             self._pending.extend(
                 self._resolve_misses(snapshot, rows, h1, h2, h3))
             self.timings["feed_miss"] = _time.perf_counter() - t0
+
+    def _new_acc(self):
+        """Fresh device accumulator (subclasses shard it)."""
+        import jax.numpy as jnp
+
+        return jnp.zeros(self._id_cap, jnp.int32)
+
+    def _feed_dispatch(self, packed: np.ndarray, n_pad: int,
+                       reset: int) -> np.ndarray:
+        """Run the feed program over the device state; returns the
+        chunk-relative miss row indices (empty in steady state). The
+        accumulator donation contract: self._acc is None while the call
+        is in flight (invalid if it throws)."""
+        import jax.numpy as jnp
+
+        prog = _feed_program(self._cap, self._id_cap, n_pad)
+        acc = self._acc
+        self._acc = None  # donated: invalid if the call throws
+        acc, n_miss, miss_rows = prog(self._dev, acc, jnp.asarray(packed),
+                                      jnp.uint32(reset))
+        self._acc = acc
+        nm = int(n_miss)  # device sync point
+        if not nm:
+            return np.empty(0, np.int64)
+        return np.asarray(miss_rows)[:nm].astype(np.int64)
+
+    def _lookup_dispatch(self, packed: np.ndarray, n_pad: int):
+        """Run the one-shot lookup program; returns (host buffer of
+        counts+n_miss, device miss-row buffer)."""
+        import jax.numpy as jnp
+
+        prog = _lookup_program(self._cap, self._id_cap, n_pad)
+        dev_out, miss_rows = prog(self._dev, jnp.asarray(packed))
+        return np.asarray(dev_out), miss_rows
+
+    def _close_fetch(self, n_fetch: int, width: int,
+                     n_over_buf: int) -> np.ndarray:
+        """Run the close pack program and fetch its packed buffer."""
+        prog = _close_program(self._id_cap, n_fetch, width, n_over_buf)
+        return np.asarray(prog(self._acc))
 
     def _pick_close_width(self) -> int:
         """Packing width for this close: the narrowest that provably (from
@@ -516,9 +555,7 @@ class DictAggregator:
             t0 = _time.perf_counter()
             while True:
                 per32 = 32 // width
-                prog = _close_program(self._id_cap, n_fetch, width,
-                                      n_over_buf)
-                host = np.asarray(prog(self._acc))
+                host = self._close_fetch(n_fetch, width, n_over_buf)
                 n_over = int(host[-2])
                 if int(host[-1]) != 0:
                     raise AssertionError("count mass beyond fetched prefix")
@@ -782,14 +819,21 @@ class DictAggregator:
 
         if new_slots:
             self._register_stacks_bulk(snapshot, np.array(new_rows, np.int64))
-            idx = jnp.asarray(np.array(new_slots, np.int32))
+            slots = np.array(new_slots, np.int64)
             vals = np.zeros((len(new_slots), 4), np.uint32)
             vals[:, 0] = self._h1[new_slots]
             vals[:, 1] = self._h2[new_slots]
             vals[:, 2] = self._h3[new_slots]
             vals[:, 3] = (self._ids[new_slots] + 1).astype(np.uint32)
-            self._dev = self._dev.at[idx].set(jnp.asarray(vals))
+            self._dev_scatter(slots, vals)
         return pending
+
+    def _dev_scatter(self, slots: np.ndarray, vals: np.ndarray) -> None:
+        """Write newly inserted rows into the device table twin."""
+        import jax.numpy as jnp
+
+        self._dev = self._dev.at[jnp.asarray(slots.astype(np.int32))].set(
+            jnp.asarray(vals))
 
     def _host_insert_slot(self, key: tuple) -> int:
         # Capacity was validated batch-wide by _handle_misses.

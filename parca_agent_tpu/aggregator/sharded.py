@@ -1,0 +1,246 @@
+"""Data-parallel dict aggregation: the stack dictionary sharded over a
+device mesh (SURVEY.md section 2.12 — sharding pids/stack-ids across TPU
+cores via shard_map inside the aggregation, not only at fleet merge).
+
+Design (the TPU-native analog of the reference's 3-way unwind-table shard
+partition, pkg/profiler/cpu/maps.go:40-43, applied to the hot table):
+
+  * Every key has a HOME SHARD, h2 % n_shards; shard d owns a private
+    sub-table of capacity/n_shards slots, and the open-addressing probe
+    (h1-based linear chain) runs entirely within the home sub-table. The
+    device table is [n_shards, cap_s, 4] sharded over axis 0 of the mesh.
+  * The packed feed buffer is replicated to all shards; each shard masks
+    to its own keys (cnt forced to 0 elsewhere) and probes only its
+    sub-table — the probe work and table memory split N ways.
+  * The accumulator is PARTIAL per shard ([n_shards, id_cap], sharded):
+    shard d accumulates only its keys' counts under the global dense stack
+    ids. Window close is ONE collective: psum over the shard axis, then
+    the same pack-to-uint{4,8,16} + overflow sideband as the single-chip
+    close, fetched once.
+
+The host mirror reuses DictAggregator's arrays with slot = shard * cap_s +
+within-shard index, so insertion, rotation, eviction, sketch degradation,
+and the unreachable-key prefilter all inherit unchanged; only the slot
+placement rule and the four device dispatch hooks differ.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from parca_agent_tpu.aggregator.dict import (
+    _PROBES,
+    DictAggregator,
+    make_close,
+)
+from parca_agent_tpu.parallel.mesh import FLEET_AXIS, fleet_mesh
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_feed_program(mesh, n_shards: int, cap_s: int, id_cap: int,
+                          n_pad: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def node_fn(table, acc, packed, reset):
+        # table [1, cap_s, 4]; acc [1, id_cap]; packed replicated [4, n_pad].
+        my = jax.lax.axis_index(FLEET_AXIS).astype(jnp.uint32)
+        t = table[0]
+        a = jnp.where(reset != 0, 0, acc[0])
+        h1, h2, h3 = packed[0], packed[1], packed[2]
+        cnt = packed[3].astype(jnp.int32)
+        mine = (h2 % jnp.uint32(n_shards)) == my
+        live = mine & (cnt > 0)
+        mask = jnp.uint32(cap_s - 1)
+
+        def probe(k, state):
+            found_id, done = state
+            idx = ((h1 + jnp.uint32(k)) & mask).astype(jnp.int32)
+            row = t[idx]
+            occ = row[:, 3] > 0
+            hit = occ & (row[:, 0] == h1) & (row[:, 1] == h2) \
+                & (row[:, 2] == h3)
+            stop = hit | ~occ
+            found_id = jnp.where(hit & ~done,
+                                 row[:, 3].astype(jnp.int32) - 1, found_id)
+            return found_id, done | stop
+
+        # The probe reads the node-sharded table, so the loop carry is
+        # node-varying; mark the (replicated) initial carry to match.
+        found_id = jax.lax.pcast(jnp.full(h1.shape, -1, jnp.int32),
+                                 (FLEET_AXIS,), to="varying")
+        done = jax.lax.pcast(jnp.zeros(h1.shape, bool),
+                             (FLEET_AXIS,), to="varying")
+        found_id, _ = jax.lax.fori_loop(0, _PROBES, probe, (found_id, done))
+
+        hit = (found_id >= 0) & live
+        a = a.at[jnp.where(hit, found_id, id_cap)].add(
+            jnp.where(live, cnt, 0), mode="drop")
+        miss = live & ~hit
+        mtgt = jnp.where(miss, jnp.cumsum(miss.astype(jnp.int32)) - 1,
+                         jnp.int32(n_pad))
+        miss_rows = jnp.full((n_pad,), -1, jnp.int32).at[mtgt].set(
+            jnp.arange(h1.shape[0], dtype=jnp.int32), mode="drop")
+        n_miss = miss.astype(jnp.int32).sum()
+        return a[None], n_miss[None], miss_rows[None]
+
+    fn = jax.shard_map(
+        node_fn,
+        mesh=mesh,
+        in_specs=(P(FLEET_AXIS, None, None), P(FLEET_AXIS, None),
+                  P(None, None), P()),
+        out_specs=(P(FLEET_AXIS, None), P(FLEET_AXIS), P(FLEET_AXIS, None)),
+    )
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=24)
+def _sharded_close_program(mesh, n_shards: int, id_cap: int, n_fetch: int,
+                           width: int, n_over_buf: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    pack = make_close(id_cap, n_fetch, width, n_over_buf)
+
+    def node_fn(acc):
+        total = jax.lax.psum(acc[0], FLEET_AXIS)  # [id_cap] on every shard
+        # Pack redundantly on every shard (collective-simple); the host
+        # fetches one shard's copy.
+        return pack(total)[None]
+
+    fn = jax.shard_map(node_fn, mesh=mesh, in_specs=(P(FLEET_AXIS, None),),
+                       out_specs=P(FLEET_AXIS, None))
+    return jax.jit(fn)
+
+
+class ShardedDictAggregator(DictAggregator):
+    """DictAggregator with the device table and probe work sharded over an
+    n-device mesh. Semantics (exact counts, miss/insert protocol, sketch
+    degradation, rotation) are identical to the single-chip dict; only
+    placement and dispatch differ. aggregate()/window_counts run through
+    the streaming feed/close protocol (closing any open window first)."""
+
+    name = "sharded-dict"
+
+    def __init__(self, capacity: int = 1 << 21, id_cap: int | None = None,
+                 mesh=None, n_shards: int | None = None, **kw):
+        if mesh is None:
+            import jax
+
+            mesh = fleet_mesh(n_shards or len(jax.devices()))
+        self._mesh = mesh
+        self._n_shards = mesh.devices.size
+        if capacity % self._n_shards:
+            raise ValueError("capacity must divide by the shard count")
+        cap_s = capacity // self._n_shards
+        if cap_s & (cap_s - 1):
+            raise ValueError("per-shard capacity must be a power of two")
+        self._cap_s = cap_s
+        super().__init__(capacity=capacity, id_cap=id_cap, **kw)
+
+    # -- host-mirror placement: probe within the key's home sub-table -------
+
+    def _home_shard(self, key: tuple) -> int:
+        return key[1] % self._n_shards
+
+    def _host_insert_slot(self, key: tuple) -> int:
+        base = self._home_shard(key) * self._cap_s
+        mask = self._cap_s - 1
+        idx = key[0] & mask
+        while self._occ[base + idx]:
+            idx = (idx + 1) & mask
+        return base + idx
+
+    def _chain_dist(self, key: tuple, slot: int) -> int:
+        mask = self._cap_s - 1
+        within = slot - self._home_shard(key) * self._cap_s
+        return (within - (key[0] & mask)) & mask
+
+    # -- device dispatch ------------------------------------------------------
+
+    def _ensure_device(self) -> None:
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if self._dev is None:
+            table = np.zeros((self._cap, 4), np.uint32)
+            table[:, 0] = self._h1
+            table[:, 1] = self._h2
+            table[:, 2] = self._h3
+            table[:, 3] = np.where(self._occ, self._ids + 1, 0).astype(
+                np.uint32)
+            table = table.reshape(self._n_shards, self._cap_s, 4)
+            self._dev = jax.device_put(
+                table, NamedSharding(self._mesh, P(FLEET_AXIS, None, None)))
+
+    def _new_acc(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return jax.device_put(
+            jnp.zeros((self._n_shards, self._id_cap), jnp.int32),
+            NamedSharding(self._mesh, P(FLEET_AXIS, None)))
+
+    def _feed_dispatch(self, packed: np.ndarray, n_pad: int,
+                       reset: int) -> np.ndarray:
+        import jax.numpy as jnp
+
+        prog = _sharded_feed_program(self._mesh, self._n_shards, self._cap_s,
+                                     self._id_cap, n_pad)
+        acc = self._acc
+        self._acc = None  # donated: invalid if the call throws
+        acc, n_miss, miss_rows = prog(self._dev, acc, jnp.asarray(packed),
+                                      jnp.uint32(reset))
+        self._acc = acc
+        per_shard = np.asarray(n_miss)
+        if not per_shard.any():
+            return np.empty(0, np.int64)
+        # Each row has exactly one home shard, so the per-shard miss lists
+        # are disjoint; concatenate them.
+        rows_all = np.asarray(miss_rows)
+        return np.concatenate([
+            rows_all[s, : int(k)] for s, k in enumerate(per_shard) if k
+        ]).astype(np.int64)
+
+    def _close_fetch(self, n_fetch: int, width: int,
+                     n_over_buf: int) -> np.ndarray:
+        prog = _sharded_close_program(self._mesh, self._n_shards,
+                                      self._id_cap, n_fetch, width,
+                                      n_over_buf)
+        out = prog(self._acc)
+        return np.asarray(out[0])  # every shard holds the same packed copy
+
+    def _dev_scatter(self, slots: np.ndarray, vals: np.ndarray) -> None:
+        import jax.numpy as jnp
+
+        s_idx = (slots // self._cap_s).astype(np.int32)
+        w_idx = (slots % self._cap_s).astype(np.int32)
+        self._dev = self._dev.at[jnp.asarray(s_idx), jnp.asarray(w_idx)].set(
+            jnp.asarray(vals))
+
+    # -- one-shot paths ride the streaming protocol ---------------------------
+
+    def window_counts(self, snapshot, hashes=None) -> np.ndarray:
+        if len(snapshot) == 0:
+            return np.zeros(self._next_id, np.int64)
+        if self._fed_total or self._pending:
+            # One-shot semantics: any partially-fed window is discarded
+            # (the single-chip lookup path leaves streaming state alone;
+            # here both ride the same accumulator, so be explicit).
+            self._fed_total = 0
+            self._pending = []
+        self._needs_reset = True
+        self.feed(snapshot, hashes)
+        return self.close_window(copy=True)
+
+    def _lookup_dispatch(self, packed, n_pad):  # pragma: no cover
+        raise NotImplementedError(
+            "sharded aggregation has no one-shot lookup program; "
+            "window_counts rides feed/close")

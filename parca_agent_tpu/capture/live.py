@@ -106,6 +106,18 @@ def load_native():
     lib.pa_decode_v1.argtypes = [
         u8p, ctypes.c_long, i32p, i32p, i32p, i32p,
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_long, ctypes.c_long]
+    lib.pa_sampler_drain_dedup.restype = ctypes.c_long
+    lib.pa_sampler_drain_dedup.argtypes = [ctypes.c_void_p, u8p,
+                                           ctypes.c_long]
+    lib.pa_sampler_dedup_hits.restype = ctypes.c_uint64
+    lib.pa_sampler_dedup_hits.argtypes = [ctypes.c_void_p]
+    lib.pa_decode_v1d_count.restype = ctypes.c_long
+    lib.pa_decode_v1d_count.argtypes = [u8p, ctypes.c_long, ctypes.c_long]
+    lib.pa_decode_v1d.restype = ctypes.c_long
+    lib.pa_decode_v1d.argtypes = [
+        u8p, ctypes.c_long, i32p, i32p, i32p, i32p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_long, ctypes.c_long]
     return lib
 
 
@@ -182,14 +194,49 @@ def decode_records_columnar(lib, buf, nbytes: int) -> tuple:
     return pids, tids, ulen, klen, stacks
 
 
+def decode_records_columnar_v1d(lib, buf, nbytes: int) -> tuple:
+    """Native one-pass v1d decode (dedup-drain records, 24-byte header
+    with a count field) into columnar arrays. Returns (pids, tids, ulen,
+    klen, stacks, counts) with user frames first per row."""
+    if isinstance(buf, (bytes, bytearray)):
+        buf = (ctypes.c_uint8 * nbytes).from_buffer_copy(buf[:nbytes])
+    p = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+    n = int(lib.pa_decode_v1d_count(p, nbytes, STACK_SLOTS))
+    pids = np.zeros(n, np.int32)
+    tids = np.zeros(n, np.int32)
+    ulen = np.zeros(n, np.int32)
+    klen = np.zeros(n, np.int32)
+    counts = np.zeros(n, np.int64)
+    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+    if n:
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        got = int(lib.pa_decode_v1d(
+            p, nbytes,
+            pids.ctypes.data_as(i32p),
+            tids.ctypes.data_as(i32p),
+            ulen.ctypes.data_as(i32p),
+            klen.ctypes.data_as(i32p),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            stacks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            STACK_SLOTS, n))
+        assert got == n, (got, n)
+    return pids, tids, ulen, klen, stacks, counts
+
+
 def columns_to_snapshot(
     pids, tids, ulen, klen, stacks,
     mappings: MappingTable, period_ns: int, window_ns: int,
+    weights=None,
 ) -> WindowSnapshot:
     """Dedup identical (pid, tid, stack) rows into counted rows (the role
     the BPF stack_counts map plays in the reference). Columnar input from
-    the native decoder or from records_to_snapshot's packing."""
+    the native decoder or from records_to_snapshot's packing. `weights`
+    carries per-row pre-aggregated counts (the native dedup drain emits
+    them); rows still merge here — drain passes and table overflows leave
+    best-effort duplicates — with counts summed."""
     pids = np.asarray(pids, np.int32)
+    if weights is not None:
+        weights = np.asarray(weights, np.int64)
     if len(pids) and int(pids.min()) < 0:
         # perf delivers unattributable/idle-context samples as pid -1;
         # they carry no process to profile, and downstream the uint32
@@ -200,6 +247,8 @@ def columns_to_snapshot(
         pids, tids = pids[keep], np.asarray(tids)[keep]
         ulen, klen = np.asarray(ulen)[keep], np.asarray(klen)[keep]
         stacks = np.asarray(stacks)[keep]
+        if weights is not None:
+            weights = weights[keep]
     n = len(pids)
     if n == 0:
         return WindowSnapshot(
@@ -224,7 +273,8 @@ def columns_to_snapshot(
     void = np.ascontiguousarray(rec).view(
         np.dtype((np.void, rec.shape[1] * 8))).ravel()
     _, first, inverse = np.unique(void, return_index=True, return_inverse=True)
-    counts = np.bincount(inverse, minlength=len(first)).astype(np.int64)
+    counts = np.bincount(
+        inverse, weights=weights, minlength=len(first)).astype(np.int64)
     return WindowSnapshot(
         pids=pids[first], tids=tids[first], counts=counts,
         user_len=ulen[first], kernel_len=klen[first], stacks=stacks[first],
@@ -517,13 +567,21 @@ class PerfEventSampler:
     def truncated_drains(self) -> int:
         return int(self._lib.pa_sampler_truncated(self._handle))
 
-    def _drain_passes(self, consume) -> None:
+    @property
+    def dedup_hits(self) -> int:
+        """Samples merged into an existing row at the drain boundary
+        (capture-side pre-aggregation effectiveness)."""
+        return int(self._lib.pa_sampler_dedup_hits(self._handle))
+
+    def _drain_passes(self, consume, dedup: bool = False) -> None:
         """Lossless drain: loops while the native side reports records
         left behind for lack of buffer space, handing each pass's
         (buffer, n_bytes) to `consume` before the buffer is reused."""
+        drain = (self._lib.pa_sampler_drain_dedup if dedup
+                 else self._lib.pa_sampler_drain)
         for _ in range(64):  # safety bound; one pass is the norm
             before = self.truncated_drains
-            n = self._lib.pa_sampler_drain(
+            n = drain(
                 self._handle, self._drainbuf, ctypes.c_long(self._cap))
             if n < 0:
                 raise SamplerUnavailable("sampler drain failed")
@@ -539,12 +597,15 @@ class PerfEventSampler:
         return b"".join(chunks)
 
     def _drain_columnar(self) -> list[tuple]:
-        """Lossless drain with the native columnar decoder applied per
-        pass, straight off the reusable drain buffer (no bytes copy)."""
+        """Lossless DEDUP drain with the native columnar decoder applied
+        per pass, straight off the reusable drain buffer (no bytes copy).
+        The native side pre-aggregates repeats to (row, count) so Python
+        decodes ~unique rows (the reference's in-kernel envelope)."""
         cols = []
         self._drain_passes(
             lambda buf, n: cols.append(
-                decode_records_columnar(self._lib, buf, n)))
+                decode_records_columnar_v1d(self._lib, buf, n)),
+            dedup=True)
         return cols
 
     def poll(self) -> WindowSnapshot:
@@ -581,7 +642,8 @@ class PerfEventSampler:
                     for i, z in enumerate((
                         np.zeros(0, np.int32), np.zeros(0, np.int32),
                         np.zeros(0, np.int32), np.zeros(0, np.int32),
-                        np.zeros((0, STACK_SLOTS), np.uint64)))]
+                        np.zeros((0, STACK_SLOTS), np.uint64),
+                        np.zeros(0, np.int64)))]
             pid_iter = np.unique(cols[0]).tolist()
         per_pid = {}
         for pid in pid_iter:
@@ -595,7 +657,8 @@ class PerfEventSampler:
         window_ns = int(self._window * 1e9)
         if self.capture_stack:
             return records_to_snapshot(records, table, period_ns, window_ns)
-        return columns_to_snapshot(*cols, table, period_ns, window_ns)
+        return columns_to_snapshot(*cols[:5], table, period_ns, window_ns,
+                                   weights=cols[5])
 
     def close(self) -> None:
         if self._handle:

@@ -89,7 +89,21 @@ struct Sampler {
   uint64_t truncated = 0;  // drain calls that ran out of caller buffer
   uint8_t* scratch = nullptr;  // wrapped-record copy buffer
   size_t scratch_size = 0;
+  // Dedup-drain hash table (lazily allocated; see pa_sampler_drain_dedup).
+  uint64_t* dd_hash = nullptr;
+  long* dd_off = nullptr;
+  size_t dd_cap = 0;
+  uint64_t dedup_hits = 0;  // records merged instead of re-emitted
 };
+
+// FNV-1a over the sample identity (pid, tid, nk, nu, frames).
+uint64_t fnv1a(const uint8_t* p, size_t n, uint64_t h = 1469598103934665603ull) {
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
 
 long perf_open(int cpu, int freq, bool capture_stack, uint32_t dump_bytes) {
   perf_event_attr attr;
@@ -121,7 +135,94 @@ void destroy_partial(Sampler* s, int opened) {
   }
   delete[] s->cpus;
   delete[] s->scratch;
+  delete[] s->dd_hash;
+  delete[] s->dd_off;
   delete s;
+}
+
+
+// Shared perf-ring record walk: wrap/scratch handling, LOST accounting,
+// context-marker frame splitting, and the leave-in-ring tail-commit
+// protocol live HERE, once, for every drain flavor. `emit` receives each
+// parsed sample (payload/rec_end cover the bytes after the callchain for
+// mode-specific parsing) and returns false when the caller's buffer is
+// full — the record is then left in its ring for the next drain.
+template <typename EmitFn>
+void walk_rings(Sampler* s, EmitFn&& emit) {
+  bool out_full = false;
+  size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+  for (int i = 0; i < s->n_cpus && !out_full; i++) {
+    PerCpu& pc = s->cpus[i];
+    auto* meta = static_cast<perf_event_mmap_page*>(pc.ring);
+    uint8_t* data = static_cast<uint8_t*>(pc.ring) + page;
+    uint64_t data_size = pc.ring_size - page;
+    uint64_t head = __atomic_load_n(&meta->data_head, __ATOMIC_ACQUIRE);
+    uint64_t tail = meta->data_tail;
+    while (tail < head) {
+      auto* hdr = reinterpret_cast<perf_event_header*>(
+          data + (tail % data_size));
+      // Records can wrap the ring; copy out when they do.
+      uint8_t* rec = reinterpret_cast<uint8_t*>(hdr);
+      if ((tail % data_size) + hdr->size > data_size) {
+        uint64_t first = data_size - (tail % data_size);
+        if (hdr->size <= s->scratch_size) {
+          std::memcpy(s->scratch, rec, first);
+          std::memcpy(s->scratch + first, data, hdr->size - first);
+          rec = s->scratch;
+          hdr = reinterpret_cast<perf_event_header*>(rec);
+        } else {  // oversized wrapped record: skip
+          tail += hdr->size;
+          continue;
+        }
+      }
+      if (hdr->type == PERF_RECORD_LOST) {
+        // { header; u64 id; u64 lost; }
+        s->lost += *reinterpret_cast<uint64_t*>(rec + sizeof(*hdr) + 8);
+      } else if (hdr->type == PERF_RECORD_SAMPLE) {
+        // layout for our sample_type (in ABI order):
+        //   u32 pid, tid; u64 nr; u64 ips[nr];
+        //   [u64 regs_abi; u64 regs[3] if abi != NONE]
+        //   [u64 stack_size; u8 stack[stack_size]; u64 dyn_size if size]
+        uint8_t* p = rec + sizeof(*hdr);
+        uint8_t* rec_end = rec + hdr->size;
+        uint32_t pid, tid;
+        std::memcpy(&pid, p, 4);
+        std::memcpy(&tid, p + 4, 4);
+        p += 8;
+        uint64_t nr;
+        std::memcpy(&nr, p, 8);
+        p += 8;
+        if (nr <= kMaxFrames + 8 && p + 8 * nr <= rec_end) {
+          uint64_t kframes[kMaxFrames], uframes[kMaxFrames];
+          uint32_t nk = 0, nu = 0;
+          int mode = 0;  // 0 unknown, 1 kernel, 2 user
+          for (uint64_t f = 0; f < nr; f++) {
+            uint64_t ip;
+            std::memcpy(&ip, p + 8 * f, 8);
+            if (ip >= kContextMax) {
+              if (ip == kContextKernel) mode = 1;
+              else if (ip == kContextUser) mode = 2;
+              else mode = 0;
+              continue;
+            }
+            if (mode == 1 && nk < kMaxFrames) kframes[nk++] = ip;
+            else if (mode == 2 && nu < kMaxFrames) uframes[nu++] = ip;
+          }
+          p += 8 * nr;
+          if (!emit(pid, tid, kframes, nk, uframes, nu, p, rec_end)) {
+            // Leave this record (and the rest of this ring) for the
+            // next drain; commit only what we already consumed.
+            s->truncated++;
+            out_full = true;
+            break;
+          }
+        }
+      }
+      tail += hdr->size;
+    }
+    __atomic_store_n(&meta->data_tail, tail, __ATOMIC_RELEASE);
+    pc.tail = tail;
+  }
 }
 
 }  // namespace
@@ -215,155 +316,213 @@ int pa_sampler_stop(Sampler* s) {
 long pa_sampler_drain(Sampler* s, uint8_t* out, long cap) {
   if (!s || !out || cap < 0) return -1;
   long written = 0;
-  bool out_full = false;
-  size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
-  for (int i = 0; i < s->n_cpus && !out_full; i++) {
-    PerCpu& pc = s->cpus[i];
-    auto* meta = static_cast<perf_event_mmap_page*>(pc.ring);
-    uint8_t* data = static_cast<uint8_t*>(pc.ring) + page;
-    uint64_t data_size = pc.ring_size - page;
-    uint64_t head = __atomic_load_n(&meta->data_head, __ATOMIC_ACQUIRE);
-    uint64_t tail = meta->data_tail;
-    while (tail < head) {
-      auto* hdr = reinterpret_cast<perf_event_header*>(
-          data + (tail % data_size));
-      // Records can wrap the ring; copy out when they do.
-      uint8_t* rec = reinterpret_cast<uint8_t*>(hdr);
-      if ((tail % data_size) + hdr->size > data_size) {
-        uint64_t first = data_size - (tail % data_size);
-        if (hdr->size <= s->scratch_size) {
-          std::memcpy(s->scratch, rec, first);
-          std::memcpy(s->scratch + first, data, hdr->size - first);
-          rec = s->scratch;
-          hdr = reinterpret_cast<perf_event_header*>(rec);
-        } else {  // oversized wrapped record: skip
-          tail += hdr->size;
-          continue;
+  walk_rings(s, [&](uint32_t pid, uint32_t tid, uint64_t* kframes,
+                    uint32_t nk, uint64_t* uframes, uint32_t nu,
+                    uint8_t* p, uint8_t* rec_end) -> bool {
+    uint64_t rip = 0, rsp = 0, rbp = 0;
+    uint8_t* stack = nullptr;
+    uint64_t dyn = 0;
+    bool parse_ok = true;
+    if (s->capture_stack) {
+      // REGS_USER: abi word, then one u64 per set mask bit in
+      // ascending bit order: BP(6), SP(7), IP(8).
+      if (p + 8 <= rec_end) {
+        uint64_t abi;
+        std::memcpy(&abi, p, 8);
+        p += 8;
+        if (abi != 0 /* PERF_SAMPLE_REGS_ABI_NONE */) {
+          if (p + 24 <= rec_end) {
+            std::memcpy(&rbp, p, 8);
+            std::memcpy(&rsp, p + 8, 8);
+            std::memcpy(&rip, p + 16, 8);
+            p += 24;
+          } else {
+            parse_ok = false;
+          }
         }
+      } else {
+        parse_ok = false;
       }
-      if (hdr->type == PERF_RECORD_LOST) {
-        // { header; u64 id; u64 lost; }
-        s->lost += *reinterpret_cast<uint64_t*>(rec + sizeof(*hdr) + 8);
-      } else if (hdr->type == PERF_RECORD_SAMPLE) {
-        // layout for our sample_type (in ABI order):
-        //   u32 pid, tid; u64 nr; u64 ips[nr];
-        //   [u64 regs_abi; u64 regs[3] if abi != NONE]
-        //   [u64 stack_size; u8 stack[stack_size]; u64 dyn_size if size]
-        uint8_t* p = rec + sizeof(*hdr);
-        uint8_t* rec_end = rec + hdr->size;
-        uint32_t pid, tid;
-        std::memcpy(&pid, p, 4);
-        std::memcpy(&tid, p + 4, 4);
+      // STACK_USER: size word, raw bytes, dyn_size word.
+      if (parse_ok && p + 8 <= rec_end) {
+        uint64_t size;
+        std::memcpy(&size, p, 8);
         p += 8;
-        uint64_t nr;
-        std::memcpy(&nr, p, 8);
-        p += 8;
-        if (nr <= kMaxFrames + 8 && p + 8 * nr <= rec_end) {
-          uint64_t kframes[kMaxFrames], uframes[kMaxFrames];
-          uint32_t nk = 0, nu = 0;
-          int mode = 0;  // 0 unknown, 1 kernel, 2 user
-          for (uint64_t f = 0; f < nr; f++) {
-            uint64_t ip;
-            std::memcpy(&ip, p + 8 * f, 8);
-            if (ip >= kContextMax) {
-              if (ip == kContextKernel) mode = 1;
-              else if (ip == kContextUser) mode = 2;
-              else mode = 0;
-              continue;
-            }
-            if (mode == 1 && nk < kMaxFrames) kframes[nk++] = ip;
-            else if (mode == 2 && nu < kMaxFrames) uframes[nu++] = ip;
-          }
-          p += 8 * nr;
-
-          uint64_t rip = 0, rsp = 0, rbp = 0;
-          uint8_t* stack = nullptr;
-          uint64_t dyn = 0;
-          bool parse_ok = true;
-          if (s->capture_stack) {
-            // REGS_USER: abi word, then one u64 per set mask bit in
-            // ascending bit order: BP(6), SP(7), IP(8).
-            if (p + 8 <= rec_end) {
-              uint64_t abi;
-              std::memcpy(&abi, p, 8);
-              p += 8;
-              if (abi != 0 /* PERF_SAMPLE_REGS_ABI_NONE */) {
-                if (p + 24 <= rec_end) {
-                  std::memcpy(&rbp, p, 8);
-                  std::memcpy(&rsp, p + 8, 8);
-                  std::memcpy(&rip, p + 16, 8);
-                  p += 24;
-                } else {
-                  parse_ok = false;
-                }
-              }
-            } else {
-              parse_ok = false;
-            }
-            // STACK_USER: size word, raw bytes, dyn_size word.
-            if (parse_ok && p + 8 <= rec_end) {
-              uint64_t size;
-              std::memcpy(&size, p, 8);
-              p += 8;
-              if (size) {
-                if (p + size + 8 <= rec_end) {
-                  stack = p;
-                  p += size;
-                  std::memcpy(&dyn, p, 8);
-                  p += 8;
-                  if (dyn > size) dyn = size;
-                } else {
-                  parse_ok = false;
-                }
-              }
-            }
-          }
-
-          if (parse_ok && nk + nu + (rip ? 1 : 0) > 0 &&
-              nk + nu <= kMaxFrames) {
-            uint64_t dyn_pad = (dyn + 7) & ~7ull;
-            long need = 16 + 8l * (nk + nu);
-            if (s->capture_stack) need += 32 + static_cast<long>(dyn_pad);
-            if (written + need > cap) {
-              // Leave this record (and the rest of this ring) for the
-              // next drain; commit only what we already consumed.
-              s->truncated++;
-              out_full = true;
-              break;
-            }
-            uint8_t* o = out + written;
-            std::memcpy(o, &pid, 4);
-            std::memcpy(o + 4, &tid, 4);
-            std::memcpy(o + 8, &nk, 4);
-            std::memcpy(o + 12, &nu, 4);
-            o += 16;
-            if (s->capture_stack) {
-              uint32_t dyn32 = static_cast<uint32_t>(dyn);
-              uint32_t zero = 0;
-              std::memcpy(o, &rip, 8);
-              std::memcpy(o + 8, &rsp, 8);
-              std::memcpy(o + 16, &rbp, 8);
-              std::memcpy(o + 24, &dyn32, 4);
-              std::memcpy(o + 28, &zero, 4);
-              o += 32;
-            }
-            std::memcpy(o, kframes, 8l * nk);
-            std::memcpy(o + 8l * nk, uframes, 8l * nu);
-            o += 8l * (nk + nu);
-            if (s->capture_stack && dyn_pad) {
-              std::memcpy(o, stack, dyn);
-              std::memset(o + dyn, 0, dyn_pad - dyn);
-            }
-            written += need;
+        if (size) {
+          if (p + size + 8 <= rec_end) {
+            stack = p;
+            p += size;
+            std::memcpy(&dyn, p, 8);
+            p += 8;
+            if (dyn > size) dyn = size;
+          } else {
+            parse_ok = false;
           }
         }
       }
-      tail += hdr->size;
     }
-    __atomic_store_n(&meta->data_tail, tail, __ATOMIC_RELEASE);
-    pc.tail = tail;
-  }
+
+    if (!(parse_ok && nk + nu + (rip ? 1 : 0) > 0 && nk + nu <= kMaxFrames))
+      return true;  // unusable sample: consumed, nothing emitted
+    uint64_t dyn_pad = (dyn + 7) & ~7ull;
+    long need = 16 + 8l * (nk + nu);
+    if (s->capture_stack) need += 32 + static_cast<long>(dyn_pad);
+    if (written + need > cap) return false;
+    uint8_t* o = out + written;
+    std::memcpy(o, &pid, 4);
+    std::memcpy(o + 4, &tid, 4);
+    std::memcpy(o + 8, &nk, 4);
+    std::memcpy(o + 12, &nu, 4);
+    o += 16;
+    if (s->capture_stack) {
+      uint32_t dyn32 = static_cast<uint32_t>(dyn);
+      uint32_t zero = 0;
+      std::memcpy(o, &rip, 8);
+      std::memcpy(o + 8, &rsp, 8);
+      std::memcpy(o + 16, &rbp, 8);
+      std::memcpy(o + 24, &dyn32, 4);
+      std::memcpy(o + 28, &zero, 4);
+      o += 32;
+    }
+    std::memcpy(o, kframes, 8l * nk);
+    std::memcpy(o + 8l * nk, uframes, 8l * nu);
+    o += 8l * (nk + nu);
+    if (s->capture_stack && dyn_pad) {
+      std::memcpy(o, stack, dyn);
+      std::memset(o + dyn, 0, dyn_pad - dyn);
+    }
+    written += need;
+    return true;
+  });
   return written;
+}
+
+// ---- dedup drain: capture-side (pid, tid, stack) -> count -------------
+//
+// The envelope restorer: the reference aggregates (pid, stack) -> count
+// IN KERNEL (bpf/cpu/cpu.bpf.c:110-116,457-461) so its userspace never
+// sees per-sample records; the raw drain above ships every sample. At
+// 100 Hz x many CPUs the stream is dominated by repeats of a small hot
+// set, so this drain dedups AT THE DRAIN BOUNDARY in native code: an
+// open-addressing (FNV-1a, memcmp-verified) table maps each record's
+// identity to its already-written output record and bumps a count field
+// instead of re-emitting. Python then decodes ~unique rows per drain.
+//
+// v1d record:
+//   u32 pid | u32 tid | u32 n_kernel | u32 n_user | u32 count | u32 _pad
+//   | u64 frames[n_kernel + n_user]                      (kernel first)
+//
+// FP/callchain mode only (-2 in DWARF mode: v2 records carry per-sample
+// stack slices, which are never byte-identical). Dedup is best-effort
+// within one drain pass — table overflow or cross-pass repeats emit
+// separate records, which the aggregator merges anyway; counts are exact
+// either way.
+
+long pa_sampler_drain_dedup(Sampler* s, uint8_t* out, long cap) {
+  if (!s || !out || cap < 0) return -1;
+  if (s->capture_stack) return -2;
+  if (!s->dd_hash) {
+    s->dd_cap = 1 << 16;
+    s->dd_hash = new uint64_t[s->dd_cap];
+    s->dd_off = new long[s->dd_cap];
+  }
+  std::memset(s->dd_hash, 0, s->dd_cap * sizeof(uint64_t));
+  const uint64_t dd_mask = s->dd_cap - 1;
+
+  long written = 0;
+  walk_rings(s, [&](uint32_t pid, uint32_t tid, uint64_t* kframes,
+                    uint32_t nk, uint64_t* uframes, uint32_t nu,
+                    uint8_t*, uint8_t*) -> bool {
+    uint32_t nf = nk + nu;
+    if (nf == 0 || nf > kMaxFrames) return true;  // consumed, not emitted
+    uint32_t ident[4] = {pid, tid, nk, nu};
+    uint64_t h = fnv1a(reinterpret_cast<uint8_t*>(ident), 16);
+    h = fnv1a(reinterpret_cast<uint8_t*>(kframes), 8ul * nk, h);
+    h = fnv1a(reinterpret_cast<uint8_t*>(uframes), 8ul * nu, h);
+    if (h == 0) h = 1;
+    uint64_t idx = h & dd_mask;
+    for (int probes = 0; probes < 64; probes++) {
+      if (s->dd_hash[idx] == 0) break;
+      if (s->dd_hash[idx] == h) {
+        // ident covers nk/nu, so the frame memcmp lengths below are
+        // validated by the 16-byte header compare.
+        uint8_t* o = out + s->dd_off[idx];
+        if (std::memcmp(o, ident, 16) == 0 &&
+            std::memcmp(o + 24, kframes, 8ul * nk) == 0 &&
+            std::memcmp(o + 24 + 8ul * nk, uframes, 8ul * nu) == 0) {
+          uint32_t c;
+          std::memcpy(&c, o + 16, 4);
+          c++;
+          std::memcpy(o + 16, &c, 4);
+          s->dedup_hits++;
+          return true;
+        }
+      }
+      idx = (idx + 1) & dd_mask;
+    }
+    long need = 24 + 8l * nf;
+    if (written + need > cap) return false;
+    uint8_t* o = out + written;
+    uint32_t one = 1, zero = 0;
+    std::memcpy(o, ident, 16);
+    std::memcpy(o + 16, &one, 4);
+    std::memcpy(o + 20, &zero, 4);
+    std::memcpy(o + 24, kframes, 8l * nk);
+    std::memcpy(o + 24 + 8l * nk, uframes, 8l * nu);
+    if (s->dd_hash[idx] == 0) {  // probe budget not exhausted
+      s->dd_hash[idx] = h;
+      s->dd_off[idx] = written;
+    }
+    written += need;
+    return true;
+  });
+  return written;
+}
+
+uint64_t pa_sampler_dedup_hits(Sampler* s) { return s ? s->dedup_hits : 0; }
+
+// v1d decoders: like v1 below but with the 24-byte header carrying the
+// drain-side count.
+long pa_decode_v1d_count(const uint8_t* buf, long len, long stack_slots) {
+  long pos = 0, n = 0;
+  while (pos + 24 <= len) {
+    uint32_t hdr[4];
+    std::memcpy(hdr, buf + pos, 16);
+    long nf = (long)hdr[2] + (long)hdr[3];
+    if (nf > (long)kMaxFrames || nf > stack_slots ||
+        pos + 24 + 8 * nf > len)
+      break;
+    pos += 24 + 8 * nf;
+    n++;
+  }
+  return n;
+}
+
+long pa_decode_v1d(const uint8_t* buf, long len,
+                   int32_t* pids, int32_t* tids,
+                   int32_t* ulen, int32_t* klen, int64_t* counts,
+                   uint64_t* stacks, long stack_slots, long cap) {
+  long pos = 0, n = 0;
+  while (pos + 24 <= len && n < cap) {
+    uint32_t hdr[6];
+    std::memcpy(hdr, buf + pos, 24);
+    long nk = hdr[2], nu = hdr[3];
+    long nf = nk + nu;
+    if (nf > (long)kMaxFrames || nf > stack_slots ||
+        pos + 24 + 8 * nf > len)
+      break;
+    pids[n] = (int32_t)hdr[0];
+    tids[n] = (int32_t)hdr[1];
+    klen[n] = (int32_t)nk;
+    ulen[n] = (int32_t)nu;
+    counts[n] = (int64_t)hdr[4];
+    uint64_t* row = stacks + n * stack_slots;
+    std::memcpy(row, buf + pos + 24 + 8 * nk, 8 * nu);
+    std::memcpy(row + nu, buf + pos + 24, 8 * nk);
+    pos += 24 + 8 * nf;
+    n++;
+  }
+  return n;
 }
 
 // ---- v1 drain decode: packed records -> columnar arrays ---------------
@@ -428,6 +587,8 @@ void pa_sampler_destroy(Sampler* s) {
   }
   delete[] s->cpus;
   delete[] s->scratch;
+  delete[] s->dd_hash;
+  delete[] s->dd_off;
   delete s;
 }
 

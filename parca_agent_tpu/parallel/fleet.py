@@ -225,7 +225,8 @@ def fleet_merge_exact64(node_h1, node_h2, node_counts, mesh=None):
     return uh1[live], uh2[live], uc[live]
 
 
-def fleet_merge_profiles(node_windows, mesh=None, aggregator=None):
+def fleet_merge_profiles(node_windows, mesh=None, aggregator=None,
+                         assembly_nodes: int | None = None):
     """BASELINE config #5 end state: N per-node WindowSnapshots -> ONE
     cluster-wide profile set (SURVEY.md section 2.12).
 
@@ -238,7 +239,14 @@ def fleet_merge_profiles(node_windows, mesh=None, aggregator=None):
     the (pid, tid, lens, frames) row held by whichever node produced it —
     the per-node stack dictionary role — the rows are re-assembled into one
     WindowSnapshot whose mapping table is the union of the node tables, and
-    per-pid profile assembly runs once on the merged window.
+    per-pid profile assembly runs DISTRIBUTED: pids are modulo-partitioned
+    (pid % assembly_nodes; pid is the natural shard key — a pid's profile
+    needs only that pid's rows) and each node assembles only its share, so
+    per-node assembly work is O(total/N). assembly_nodes defaults to the
+    fleet size; the partition is computed here and the per-partition
+    assemblies are independent (the real multi-process fleet runs each on
+    its owner node; in-process they run sequentially but each touches only
+    its partition's rows).
 
     Returns (profiles, merged_snapshot). Identical (pid, stack) rows on
     different nodes merge into one row with the summed count; distinct rows
@@ -322,7 +330,21 @@ def fleet_merge_profiles(node_windows, mesh=None, aggregator=None):
         time_ns=min(w.time_ns for w in ws),
     )
     agg = aggregator if aggregator is not None else CPUAggregator()
-    return agg.aggregate(merged), merged
+    n_asm = assembly_nodes or n_nodes
+    if n_asm <= 1:
+        return agg.aggregate(merged), merged
+    profiles = []
+    for node in range(n_asm):
+        sel = (merged.pids % n_asm) == node
+        if not sel.any():
+            continue
+        part = dataclasses.replace(
+            merged, pids=merged.pids[sel], tids=merged.tids[sel],
+            counts=merged.counts[sel], user_len=merged.user_len[sel],
+            kernel_len=merged.kernel_len[sel], stacks=merged.stacks[sel])
+        profiles.extend(agg.aggregate(part))
+    profiles.sort(key=lambda p: p.pid)  # pid-sorted, like single-node
+    return profiles, merged
 
 
 def fleet_merge_exact(node_hashes, node_counts, mesh=None):

@@ -92,6 +92,46 @@ def test_native_columnar_decode_matches_python():
         assert stacks.shape[1] == STACK_SLOTS if len(recs) else True
 
 
+def _pack_v1d(pid, tid, kframes, uframes, count):
+    out = struct.pack("<IIIIII", pid, tid, len(kframes), len(uframes),
+                      count, 0)
+    for f in list(kframes) + list(uframes):
+        out += struct.pack("<Q", f)
+    return out
+
+
+def test_v1d_decode_and_weighted_snapshot():
+    """The dedup-drain record format decodes with its count column, and
+    columns_to_snapshot sums weights across residual duplicate rows."""
+    from parca_agent_tpu.capture.live import (
+        columns_to_snapshot,
+        decode_records_columnar_v1d,
+    )
+
+    lib = load_native()
+    buf = (_pack_v1d(7, 8, [0xFFFF800000000010], [0x401000], 5)
+           + _pack_v1d(9, 9, [], [0x55000], 2)
+           + _pack_v1d(7, 8, [0xFFFF800000000010], [0x401000], 3))
+    pids, tids, ulen, klen, stacks, counts = decode_records_columnar_v1d(
+        lib, buf, len(buf))
+    assert pids.tolist() == [7, 9, 7]
+    assert counts.tolist() == [5, 2, 3]
+    assert ulen.tolist() == [1, 1, 1] and klen.tolist() == [1, 0, 1]
+    np.testing.assert_array_equal(stacks[0, :2],
+                                  [0x401000, 0xFFFF800000000010])
+    # Corrupt tail: prefix kept (same contract as v1).
+    p2, *_ = decode_records_columnar_v1d(lib, buf + b"\x01\x02", len(buf) + 2)
+    assert p2.tolist() == [7, 9, 7]
+
+    snap = columns_to_snapshot(
+        pids, tids, ulen, klen, stacks,
+        MappingTable.empty(), 10**7, 10**10, weights=counts)
+    # Rows 0 and 2 are identical (cross-pass residual): merged, 5 + 3.
+    assert len(snap) == 2
+    assert sorted(snap.counts.tolist()) == [2, 8]
+    assert snap.total_samples() == 10
+
+
 def test_records_to_snapshot_dedups():
     recs = decode_records(
         _pack(7, 7, [0xFFFF800000000010], [0x401000]) * 3
