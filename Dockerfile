@@ -25,6 +25,14 @@ RUN make -C parca_agent_tpu/native libpasampler.so \
 
 FROM python:3.12-slim
 
+# VCS stamping (the Go -ldflags analog the buildinfo module reads; pass
+# --build-arg VCS_REVISION=$(git rev-parse HEAD) VCS_TIME=$(git log -1
+# --format=%cI) so the containerized agent reports real build metadata).
+ARG VCS_REVISION=""
+ARG VCS_TIME=""
+ENV PARCA_AGENT_VCS_REVISION=$VCS_REVISION \
+    PARCA_AGENT_VCS_TIME=$VCS_TIME
+
 COPY --from=build /wheels /wheels
 RUN pip install --no-cache-dir /wheels/*.whl \
     # jax/pyyaml/grpcio are optional extras; install what the deployment

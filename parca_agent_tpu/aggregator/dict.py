@@ -780,6 +780,9 @@ class DictAggregator:
             # rotation at the next window boundary.
             budget = max(0, min(self._id_cap, self._cap // 2) - self._next_id)
             self._rotate_pending = True
+        # Subclass room validation (e.g. per-shard sub-table occupancy) —
+        # must run BEFORE any mutation so a raise leaves state consistent.
+        self._check_insert_room(classified, seen_batch)
 
         new_slots: list[int] = []
         new_rows: list[int] = []
@@ -798,8 +801,18 @@ class DictAggregator:
                 absorb_h.append(key[0])
                 absorb_c.append(int(snapshot.counts[r]))
                 continue
+            slot = self._try_insert_slot(key)
+            if slot is None:
+                # No placement room for this key (a subclass constraint,
+                # e.g. its home sub-table is full) even though the global
+                # budget allows it: degrade exactly like budget
+                # exhaustion. raise-mode configurations never reach here —
+                # _check_insert_room validated pre-mutation.
+                self._rotate_pending = True
+                absorb_h.append(key[0])
+                absorb_c.append(int(snapshot.counts[r]))
+                continue
             budget -= 1
-            slot = self._host_insert_slot(key)
             sid = self._next_id
             self._next_id += 1
             self._key_to_id[key] = sid
@@ -834,6 +847,16 @@ class DictAggregator:
 
         self._dev = self._dev.at[jnp.asarray(slots.astype(np.int32))].set(
             jnp.asarray(vals))
+
+    def _check_insert_room(self, classified, seen_batch) -> None:
+        """Pre-mutation room validation hook for subclasses with placement
+        constraints beyond the global capacity check (no-op here)."""
+
+    def _try_insert_slot(self, key: tuple) -> int | None:
+        """Slot for a new key, or None when the key cannot be placed
+        (subclass placement constraints). The base table has no such
+        constraint: the global capacity check guarantees a free slot."""
+        return self._host_insert_slot(key)
 
     def _host_insert_slot(self, key: tuple) -> int:
         # Capacity was validated batch-wide by _handle_misses.

@@ -147,13 +147,47 @@ class ShardedDictAggregator(DictAggregator):
     def _home_shard(self, key: tuple) -> int:
         return key[1] % self._n_shards
 
-    def _host_insert_slot(self, key: tuple) -> int:
+    def _shard_free(self) -> np.ndarray:
+        """Free slots per shard sub-table (occupancy is per home shard,
+        which the GLOBAL capacity check cannot see: a skewed h2
+        distribution can fill one sub-table while the table as a whole is
+        half empty)."""
+        occ = self._occ.reshape(self._n_shards, self._cap_s)
+        return self._cap_s - occ.sum(axis=1)
+
+    def _check_insert_room(self, classified, seen_batch) -> None:
+        if self._overflow != "raise" or not seen_batch:
+            return  # sketch mode degrades per key in _try_insert_slot
+        demand = np.zeros(self._n_shards, np.int64)
+        for key in seen_batch:
+            demand[self._home_shard(key)] += 1
+        free = self._shard_free()
+        over = np.flatnonzero(demand > free)
+        if len(over):
+            s = int(over[0])
+            raise RuntimeError(
+                f"shard sub-table {s} exhausted ({int(demand[s])} new keys "
+                f"vs {int(free[s])} free of {self._cap_s} slots); construct "
+                f"with a larger capacity or overflow='sketch'")
+
+    def _try_insert_slot(self, key: tuple) -> int | None:
         base = self._home_shard(key) * self._cap_s
         mask = self._cap_s - 1
         idx = key[0] & mask
-        while self._occ[base + idx]:
+        for _ in range(self._cap_s):
+            if not self._occ[base + idx]:
+                return base + idx
             idx = (idx + 1) & mask
-        return base + idx
+        return None  # sub-table full: caller degrades to the sketch
+
+    def _host_insert_slot(self, key: tuple) -> int:
+        # Reached only from rotation rebuild (survivor re-insertion, which
+        # can never overflow a sub-table: survivors fit where they sat)
+        # and from _try_insert_slot above via the base class.
+        slot = self._try_insert_slot(key)
+        if slot is None:
+            raise RuntimeError("shard sub-table unexpectedly full")
+        return slot
 
     def _chain_dist(self, key: tuple, slot: int) -> int:
         mask = self._cap_s - 1

@@ -136,6 +136,12 @@ def run(argv=None) -> int:
     setup_logging(args.log_level)
     log = get_logger("cli")
 
+    from parca_agent_tpu.buildinfo import collect as collect_buildinfo
+
+    binfo = collect_buildinfo()
+    log.info("starting parca-agent-tpu", version=binfo.display(),
+             python=binfo.python)
+
     # Fleet join must precede ANY jax backend touch (device probing in
     # the aggregators below would pin a single-process backend).
     if args.fleet_coordinator:
@@ -384,6 +390,9 @@ def run(argv=None) -> int:
         if hasattr(source, "truncated_drains"):
             out["parca_agent_capture_truncated_drains_total"] = \
                 source.truncated_drains
+        labels = ",".join(f'{k}="{v}"'
+                          for k, v in binfo.as_metrics().items())
+        out[f"parca_agent_build_info{{{labels}}}"] = 1
         if fleet_merger is not None:
             if fleet_merger.failed is not None:
                 # Fleet mode is dead (SPMD peer loss): surface THAT, not
@@ -393,6 +402,18 @@ def run(argv=None) -> int:
                 out["parca_agent_fleet_failed"] = 0
                 out.update({f"parca_agent_{k}": v
                             for k, v in fleet_merger.fleet_stats.items()})
+                # Staleness clocks: a PEER hang leaves failed=0 with
+                # frozen gauges; these expose it (age >> interval, or a
+                # long in-flight round, = stalled SPMD schedule).
+                import time as _time
+
+                now = _time.monotonic()
+                if fleet_merger.last_round_at is not None:
+                    out["parca_agent_fleet_last_round_age_seconds"] = \
+                        round(now - fleet_merger.last_round_at, 3)
+                if fleet_merger.round_started_at is not None:
+                    out["parca_agent_fleet_round_in_flight_seconds"] = \
+                        round(now - fleet_merger.round_started_at, 3)
         ws = getattr(source, "walk_stats", None)
         if ws is not None and ws.total:
             out["parca_agent_dwarf_walk_total"] = ws.total
@@ -410,7 +431,7 @@ def run(argv=None) -> int:
     host, _, port = args.http_address.rpartition(":")
     http = AgentHTTPServer(host or "127.0.0.1", int(port),
                            profilers=[profiler], batch_client=batch,
-                           listener=listener, version=__version__,
+                           listener=listener, version=binfo.display(),
                            extra_metrics=capture_metrics,
                            capture_info=capture_metrics)
 
@@ -444,6 +465,13 @@ def run(argv=None) -> int:
     signal.signal(signal.SIGTERM, shutdown)
 
     discovery.run()
+    if providers:
+        # Seed the labels provider with the initial discovery scrape
+        # BEFORE the first window runs; otherwise the first window's
+        # profiles ship without pod/unit labels (a one-window label lag
+        # the per-iteration refresh below can't cover).
+        discovery.wait_for_update(0, timeout=2.0)
+        sd_provider.update(discovery.groups())
     http.start()
     for t in threads:
         t.start()

@@ -86,5 +86,10 @@ def text_hash_id(ef: ElfFile) -> str | None:
 def build_id(data_or_elf) -> str | None:
     """Best-available build id for an ELF image (bytes or ElfFile)."""
     ef = data_or_elf if isinstance(data_or_elf, ElfFile) else ElfFile(data_or_elf)
-    return (go_build_id(ef) or legacy_go_build_id(ef) or gnu_build_id(ef)
+    # GNU note before the legacy text scan: a note-less binary with a
+    # GNU build id that happens to carry the legacy marker bytes in its
+    # text head must keep its GNU identity (the reference gates the
+    # legacy path on the Go note section and never raw-scans,
+    # pkg/buildid/buildid.go:43-56).
+    return (go_build_id(ef) or gnu_build_id(ef) or legacy_go_build_id(ef)
             or text_hash_id(ef))

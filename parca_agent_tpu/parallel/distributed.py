@@ -190,12 +190,22 @@ class FleetWindowMerger:
 
     def __init__(self, interval_s: float = 10.0):
         import threading
+        import time as _time
 
         self._interval = interval_s
         self._lock = threading.Lock()
         self._window = None  # (hashes, counts) of the latest closed window
         self.fleet_stats: dict = {}
         self.failed: Exception | None = None
+        self._clock = _time.monotonic
+        # Hang observability: a PEER's failure leaves this node blocked
+        # inside the next collective with failed=None and frozen last-good
+        # gauges. These two clocks make that state visible from /metrics
+        # (round age beyond ~2x the interval, or an in-flight round older
+        # than the interval, means the fleet schedule has stalled —
+        # jax.distributed offers no per-collective timeout to bound it).
+        self.last_round_at: float | None = None
+        self.round_started_at: float | None = None
 
     def submit_window(self, hashes, counts) -> None:
         """Called after each window close. `hashes` is (h1, h2) row
@@ -206,6 +216,7 @@ class FleetWindowMerger:
             self._window = (hashes, np.ascontiguousarray(counts, np.int32))
 
     def merge_round(self) -> None:
+        self.round_started_at = self._clock()
         with self._lock:
             win, self._window = self._window, None
         if win is None:
@@ -235,6 +246,8 @@ class FleetWindowMerger:
             "fleet_unique_stacks": int(len(u1)),
             "fleet_rounds": self.fleet_stats.get("fleet_rounds", 0) + 1,
         }
+        self.last_round_at = self._clock()
+        self.round_started_at = None
 
     def run(self, stop) -> None:
         """Actor loop (threading.Event stop)."""

@@ -89,3 +89,26 @@ def test_sharded_incremental_new_stacks(mesh):
 def test_sharded_capacity_validation(mesh):
     with pytest.raises(ValueError):
         ShardedDictAggregator(capacity=(1 << 13) + 8, mesh=mesh)
+
+
+def test_sharded_subtable_overflow_is_bounded(mesh):
+    """A skewed h2 distribution can fill ONE sub-table while the global
+    capacity check still passes; insertion must degrade (sketch) or raise
+    pre-mutation (raise mode) — never spin in an unbounded probe loop."""
+    agg = ShardedDictAggregator(capacity=1 << 9, mesh=mesh,
+                                overflow="raise")
+    agg._occ[: agg._cap_s] = True  # shard 0's sub-table is full
+    key = (5, 0, 7)  # h2 = 0 -> home shard 0
+    assert agg._try_insert_slot(key) is None  # bounded, not infinite
+    with pytest.raises(RuntimeError, match="sub-table"):
+        agg._check_insert_room([], {key})
+    # Another shard's key is unaffected.
+    key1 = (5, 1, 7)
+    agg._check_insert_room([], {key1})
+    assert agg._try_insert_slot(key1) is not None
+    # Sketch mode does not raise up front (the per-key path absorbs).
+    agg2 = ShardedDictAggregator(capacity=1 << 9, mesh=mesh,
+                                 overflow="sketch")
+    agg2._occ[: agg2._cap_s] = True
+    agg2._check_insert_room([], {key})
+    assert agg2._try_insert_slot(key) is None

@@ -1,0 +1,176 @@
+"""DWARF unwind quality on a real, large DSO: the host libc.
+
+The reference proves its table builder against a vendored libc.so.6
+(pkg/stack/unwind/unwind_table_test.go:45-73) and publishes a ~97% live
+walk success rate (docs/native-stack-walking/hacking.md:8-17). These tests
+hold this build to the same bar on the host's libc: full-table scale and
+quality invariants, a parse benchmark (the number published in
+docs/perf.md), and — in the live-marked test — the walk success ratio of
+a real DWARF-mode capture.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.elf.base import ElfFile
+from parca_agent_tpu.unwind.table import (
+    CFA_TYPE_END_OF_FDE,
+    CFA_TYPE_EXPRESSION,
+    CFA_TYPE_RBP,
+    CFA_TYPE_RSP,
+    ROW_DTYPE,
+    build_compact_table,
+    lookup_rows,
+)
+
+_LIBC_PATHS = (
+    "/usr/lib/x86_64-linux-gnu/libc.so.6",
+    "/lib/x86_64-linux-gnu/libc.so.6",
+    "/usr/lib64/libc.so.6",
+)
+
+
+@pytest.fixture(scope="module")
+def libc_bytes():
+    for cand in _LIBC_PATHS:
+        try:
+            with open(cand, "rb") as f:
+                return f.read()
+        except OSError:
+            continue
+    pytest.skip("no host libc found")
+
+
+@pytest.fixture(scope="module")
+def libc_table(libc_bytes):
+    ef = ElfFile(libc_bytes)
+    sec = ef.section(".eh_frame")
+    t0 = time.perf_counter()
+    table = build_compact_table(ef.section_data(sec), sec.addr)
+    build_s = time.perf_counter() - t0
+    return table, build_s
+
+
+def test_libc_table_scale_and_invariants(libc_table, libc_bytes):
+    """Full-DSO golden: scale, sortedness, row-type sanity, 16 B rows."""
+    table, build_s = libc_table
+    # A real libc carries tens of thousands of unwind rows (the reference
+    # caps per-process tables at 250k x 3 shards for exactly this class
+    # of DSO; this build's golden fixtures are 10-100 rows — far too
+    # small to expose scale bugs).
+    assert len(table) > 20_000, len(table)
+    assert table.dtype == ROW_DTYPE and table.itemsize == 16
+    pcs = table["pc"].astype(np.int64)
+    assert np.all(np.diff(pcs) >= 0)  # sorted
+    kinds, counts = np.unique(table["cfa_type"], return_counts=True)
+    by_kind = dict(zip(kinds.tolist(), counts.tolist()))
+    covered = sum(by_kind.get(k, 0) for k in
+                  (CFA_TYPE_RSP, CFA_TYPE_RBP, CFA_TYPE_EXPRESSION))
+    fallback = by_kind.get(CFA_TYPE_END_OF_FDE, 0)
+    # Every FDE contributes exactly one end marker; rule rows the walker
+    # cannot follow also fall back to it. Quality bar: >= 75% of rows are
+    # walkable rules (the reference reports a similar covered fraction on
+    # libc-class DSOs).
+    assert covered / len(table) > 0.75, by_kind
+    assert fallback > 1000  # one per FDE: thousands of functions
+    # The builder must hold its interactive envelope on a real DSO: the
+    # reference benchmarks this same operation on libc
+    # (unwind_table_test.go BenchmarkGenerateCompactUnwindTable).
+    assert build_s < 60, f"libc table build took {build_s:.1f}s"
+
+
+def test_libc_table_lookup_semantics(libc_table):
+    """Binary-search lookups over the full table: every probed PC inside
+    a covered function resolves to the row at or before it."""
+    table, _ = libc_table
+    pcs = table["pc"].astype(np.uint64)
+    rng = np.random.default_rng(3)
+    take = rng.integers(1, len(table) - 1, 500)
+    # Probe one byte past each sampled row start: the governing row is the
+    # last row whose pc <= probe (rows can share a pc; accept the run).
+    probes = pcs[take] + np.uint64(1)
+    rows = lookup_rows(table, probes)
+    ok = 0
+    for pos in range(len(take)):
+        r = int(rows[pos])
+        if r < 0:
+            continue  # probe fell on an END_OF_FDE gap: not covered
+        assert pcs[r] <= probes[pos]
+        if r + 1 < len(pcs):
+            assert pcs[r + 1] >= probes[pos] - np.uint64(1)
+        ok += 1
+    assert ok > 350  # most probes land inside walkable coverage
+
+
+@pytest.mark.live
+def test_live_dwarf_walk_success_rate():
+    """Real DWARF-mode capture against a CPU-burning child: the batched
+    .eh_frame walker must recover stacks at the reference's published
+    rate (~97%, hacking.md:8-17). Needs perf_event permission."""
+    import os
+    import subprocess
+    import sys
+
+    from parca_agent_tpu.capture.live import (
+        PerfEventSampler,
+        SamplerUnavailable,
+    )
+
+    import shutil
+    import tempfile
+
+    gxx = shutil.which("g++") or shutil.which("gcc")
+    if gxx is None:
+        pytest.skip("no C compiler for the burn target")
+    # A small compiled target (python's own binary has a huge .eh_frame —
+    # minutes of table build; a toy burner + libc builds in seconds). Call
+    # depth comes from non-inlined recursion; -fomit-frame-pointer makes
+    # the stacks FP-unwalkable, so recovered depth PROVES the DWARF walk.
+    tmp = tempfile.mkdtemp()
+    srcp = f"{tmp}/pbburn.cc"
+    binp = f"{tmp}/pbburn"
+    with open(srcp, "w") as f:
+        f.write("""
+__attribute__((noinline)) unsigned spin(unsigned x, int d) {
+  if (d > 0) return spin(x * 1103515245u + 12345u, d - 1);
+  for (int i = 0; i < 1000; i++) x = x * 1103515245u + 12345u;
+  return x;
+}
+int main() { volatile unsigned x = 1; for (;;) x = spin(x, 20); }
+""")
+    r = subprocess.run([gxx, "-O1", "-fomit-frame-pointer", "-o", binp,
+                        srcp], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    try:
+        s = PerfEventSampler(frequency_hz=199, window_s=2.0,
+                             capture_stack=True,
+                             dwarf_comm_regex="pbburn")
+    except SamplerUnavailable as e:
+        pytest.skip(f"perf_event not permitted here: {e}")
+    burn = subprocess.Popen([binp])
+    try:
+        # First window(s) queue the async unwind-table build (burn binary
+        # + libc + ld.so); walking starts once it's ready.
+        snap = s.poll()
+        for _ in range(8):
+            if s.walk_stats.total:
+                break
+            snap = s.poll()
+    finally:
+        burn.kill()
+        s.close()
+    assert snap.total_samples() > 0
+    st = s.walk_stats
+    assert st.total > 0, "no register-carrying samples walked"
+    ratio = st.success / st.total
+    # The bar: the reference's anecdotal 5393/5550 ~= 0.97. Keep a small
+    # margin for environment noise; the ratio is also exported live as
+    # parca_agent_dwarf_walk_success_ratio.
+    assert ratio >= 0.90, (ratio, st)
+    print(f"dwarf walk success ratio: {ratio:.4f} "
+          f"({st.success}/{st.total}, pid {os.getpid()})")

@@ -1,0 +1,195 @@
+"""Deploy-manifest e2e smoke: the DaemonSet boot path, locally.
+
+The reference proves the whole loop on a real cluster (e2e/ci-e2e.sh:19-60,
+e2e/e2e_test.go:70-141: agent DaemonSet -> Parca -> non-empty QueryRange).
+No cluster exists here, so this test holds the same observable boundary
+with local stand-ins: the manifest must be structurally deployable and its
+container args must parse and BOOT the real agent; kubernetes discovery
+runs against a fake API server + fake cgroup fs; the store is an
+in-process gRPC server; and the assertion is the reference's — the store
+ends up with non-empty, pod-labeled series.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.capture.formats import (
+    MappingTable,
+    WindowSnapshot,
+    save_snapshot,
+)
+
+yaml = pytest.importorskip("yaml")
+
+_MANIFEST = "deploy/daemonset.yaml"
+_NODE = "e2e-node"
+_CID = "0" * 64
+
+
+def _docs():
+    with open(_MANIFEST) as f:
+        return {d["kind"]: d for d in yaml.safe_load_all(f)}
+
+
+def _container(docs):
+    return docs["DaemonSet"]["spec"]["template"]["spec"]["containers"][0]
+
+
+def test_manifest_structure_is_deployable():
+    docs = _docs()
+    assert set(docs) >= {"DaemonSet", "ServiceAccount", "ClusterRole",
+                         "ClusterRoleBinding"}
+    spec = docs["DaemonSet"]["spec"]["template"]["spec"]
+    # The discovery/capture design requires these (PodDiscoverer validates
+    # CRI pids via host /proc; perf needs privilege).
+    assert spec["hostPID"] is True
+    c = _container(docs)
+    assert c["securityContext"]["privileged"] is True
+    assert spec["serviceAccountName"] == \
+        docs["ServiceAccount"]["metadata"]["name"]
+    # RBAC: the pod watch needs list/watch on pods.
+    rules = docs["ClusterRole"]["rules"]
+    assert any("pods" in r.get("resources", []) for r in rules)
+    binding = docs["ClusterRoleBinding"]
+    assert binding["roleRef"]["name"] == \
+        docs["ClusterRole"]["metadata"]["name"]
+    # Mount/volume pairing is consistent.
+    vols = {v["name"] for v in spec["volumes"]}
+    for m in c["volumeMounts"]:
+        assert m["name"] in vols, m
+
+
+def _manifest_args():
+    c = _container(_docs())
+    args = [a.replace("$(KUBERNETES_NODE_NAME)", _NODE) for a in c["args"]]
+    # The env the DaemonSet injects must actually be declared.
+    env_names = {e["name"] for e in c.get("env", [])}
+    assert "KUBERNETES_NODE_NAME" in env_names
+    return args
+
+
+def test_manifest_args_parse_against_the_real_cli():
+    from parca_agent_tpu.cli import build_parser
+
+    args = build_parser().parse_args(_manifest_args())
+    assert args.node == _NODE
+    assert args.enable_kubernetes_discovery
+    assert args.remote_store_insecure
+
+
+def _snap():
+    # pids 10/11 belong to the fake pod's container; 20 is a plain process.
+    pids = np.array([10, 10, 11, 20], np.int32)
+    stacks = np.zeros((4, 128), np.uint64)
+    stacks[:, 0] = 0x1000 + np.arange(4, dtype=np.uint64) * 16
+    stacks[:, 1] = 0x2000
+    return WindowSnapshot(
+        pids=pids, tids=pids.copy(),
+        counts=np.full(4, 2, np.int64),
+        user_len=np.full(4, 2, np.int32),
+        kernel_len=np.zeros(4, np.int32),
+        stacks=stacks, mappings=MappingTable.empty(),
+        period_ns=10_000_000, window_ns=10_000_000_000,
+    )
+
+
+def test_daemonset_boot_path_produces_pod_labeled_series(
+        tmp_path, monkeypatch):
+    grpc = pytest.importorskip("grpc")
+    from concurrent import futures
+
+    from parca_agent_tpu.agent.grpc_client import WRITE_RAW_METHOD
+    from parca_agent_tpu.agent.profilestore import decode_write_raw_request
+    from parca_agent_tpu.cli import run
+    from parca_agent_tpu.discovery import kubernetes as k8s
+    from parca_agent_tpu.discovery.cgroup import CgroupContainerDiscoverer
+    from parca_agent_tpu.utils.vfs import FakeFS
+
+    # Fake API server response + fake cgroup fs joining pids 10/11 to the
+    # pod's container (the PodDiscoverer join the real DaemonSet performs
+    # via the in-cluster API + host /proc).
+    pod_doc = {"items": [{
+        "metadata": {"name": "web-abc", "namespace": "prod", "uid": "u1"},
+        "spec": {"nodeName": _NODE},
+        "status": {"containerStatuses": [
+            {"name": "app", "containerID": f"containerd://{_CID}",
+             "state": {"running": {}}},
+        ]},
+    }]}
+    fs = FakeFS({
+        f"/proc/{p}/cgroup":
+            f"0::/kubepods/cri-containerd-{_CID}.scope\n".encode()
+        for p in (10, 11)
+    } | {"/proc/20/cgroup": b"0::/user.slice\n"})
+
+    real = k8s.PodDiscoverer
+
+    def patched(node=None, cri=None, **kw):
+        return real(node=node,
+                    lister=lambda n: k8s.parse_pod_list(pod_doc),
+                    cgroups=CgroupContainerDiscoverer(fs=fs), **kw)
+
+    monkeypatch.setattr(k8s, "PodDiscoverer", patched)
+
+    received = []
+    got_any = threading.Event()
+
+    def handler(request, context):
+        series, _ = decode_write_raw_request(request)
+        received.extend(series)
+        got_any.set()
+        return b""
+
+    svc, method = WRITE_RAW_METHOD.lstrip("/").rsplit("/", 1)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+        svc, {method: grpc.unary_unary_rpc_method_handler(
+            handler, request_deserializer=lambda b: b,
+            response_serializer=lambda b: b)},
+    ),))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+
+    snap_path = tmp_path / "w.snap"
+    save_snapshot(_snap(), str(snap_path))
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text("relabel_configs: []\n")
+
+    # The manifest's args verbatim, then local overrides for everything
+    # that is genuinely environment-bound (argparse keeps the LAST value):
+    # the cluster-DNS store -> loopback port, the fixed pod port -> an
+    # ephemeral one, the ConfigMap path -> a temp file, live perf capture
+    # -> deterministic replay, plus a bounded window count.
+    argv = _manifest_args() + [
+        "--remote-store-address", f"127.0.0.1:{port}",
+        "--remote-store-batch-write-interval", "0.2",
+        "--http-address", "127.0.0.1:0",
+        "--config-path", str(cfg),
+        "--capture", "replay", "--replay", str(snap_path),
+        "--windows", "1",
+        "--debuginfo-upload-disable",
+    ]
+    try:
+        rc = run(argv)
+        assert rc == 0
+        assert got_any.wait(10), "store never received a WriteRaw"
+    finally:
+        server.stop(0)
+
+    # The reference's acceptance criterion: non-empty series for the
+    # profiled workload, carrying the pod's discovery labels.
+    by_pid = {s.labels["pid"]: s for s in received}
+    assert set(by_pid) == {"10", "11", "20"}
+    for p in ("10", "11"):
+        s = by_pid[p]
+        assert s.labels["__name__"] == "parca_agent_cpu"
+        assert s.labels["node"] == _NODE
+        assert s.labels["pod"] == "web-abc"
+        assert s.labels["namespace"] == "prod"
+        assert s.labels["container"] == "app"
+        assert s.samples
+    assert "pod" not in by_pid["20"].labels  # plain process: no pod labels

@@ -441,3 +441,23 @@ def test_status_page_renders_process_errors():
     p.process_last_errors[13] = RuntimeError("unwind failed")
     html_out = render_status_page([p])
     assert "12" in html_out and "unwind failed" in html_out
+
+
+def test_buildinfo_collects_and_never_raises(monkeypatch):
+    """Buildinfo (reference pkg/buildinfo analog): git metadata in a
+    checkout, env stamping in containers, bare version otherwise."""
+    import parca_agent_tpu.buildinfo as bi
+
+    bi.collect.cache_clear()
+    info = bi.collect()
+    assert info.version
+    assert info.display().startswith(info.version)
+    # Env stamping wins over git probing (container images).
+    bi.collect.cache_clear()
+    monkeypatch.setenv("PARCA_AGENT_VCS_REVISION", "f" * 40)
+    info2 = bi.collect()
+    assert info2.vcs_revision == "f" * 40
+    assert "ffffffffffff" in info2.display()
+    m = info2.as_metrics()
+    assert m["revision"] == "f" * 40 and m["version"] == info2.version
+    bi.collect.cache_clear()
