@@ -545,6 +545,23 @@ def _last_resort(err: str, rows: int, pids: int) -> dict:
     }
 
 
+def _probe_main() -> None:
+    """Device-liveness probe child: backend init + one tiny round trip,
+    nothing else. Prints one JSON line on success. Exists because a dead
+    dev tunnel hangs *inside* backend init (unkillable in-process; r4:
+    900 s burned before the supervisor could conclude anything) — a cheap
+    probe child bounds that discovery to its own timeout and its success
+    also warms the persistent compile cache for the main attempt."""
+    import jax
+
+    _progress(f"probe: jax up, backend={jax.default_backend()}")
+    x = jax.device_put(np.zeros(8, np.int32))
+    y = np.asarray(jax.jit(lambda a: a + 1)(x))
+    assert int(y[0]) == 1
+    print(json.dumps({"probe": "ok", "backend": jax.default_backend()}),
+          flush=True)
+
+
 def _child_main() -> None:
     """The measurement process: no supervision, just run and print."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
@@ -563,6 +580,9 @@ def _child_main() -> None:
 
 
 def main() -> None:
+    if os.environ.get("PARCA_BENCH_PROBE_CHILD"):
+        _probe_main()
+        return
     if os.environ.get("PARCA_BENCH_CHILD"):
         _child_main()
         return
@@ -610,10 +630,36 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - children can still generate
         _progress(f"snapshot pre-generation failed (non-fatal): {e!r}")
 
+    # Device-liveness probe before the expensive attempt: a dead tunnel
+    # hangs inside backend init, so discovering it must cost far less than
+    # the main attempt's 900 s budget (r4: a wedged tunnel burned the full
+    # budget inside `import jax`). ONE probe (its success also warms the
+    # backend path for the main attempt); a fast failure — crash, not hang
+    # — gets one retry after a pause, a hang means wedged and does not.
+    probe_timeout = float(os.environ.get("PARCA_BENCH_PROBE_TIMEOUT_S", 420))
+    device_alive = ambient_cpu or \
+        os.environ.get("PARCA_BENCH_PROBE", "1") == "0"
+    if not device_alive:
+        for p_try in (1, 2):
+            _progress(f"device probe {p_try} (timeout {probe_timeout:.0f}s)")
+            t0 = time.monotonic()
+            got = _run_child(probe_timeout, {"PARCA_BENCH_PROBE_CHILD": "1"})
+            if isinstance(got, dict) and got.get("probe") == "ok":
+                device_alive = True
+                _progress("device probe ok")
+                break
+            errors.append(f"device probe: {got}" if isinstance(got, str)
+                          else f"device probe: unexpected {got}")
+            _progress(f"device probe {p_try} failed")
+            if time.monotonic() - t0 > probe_timeout / 4:
+                break  # hang: the backend is wedged, a retry would too
+            if p_try == 1:
+                time.sleep(60)
+
     # Attempt 1 (+ one retry on FAST failure — a hang means the backend
     # is wedged and retrying would double the worst case) on the ambient
     # backend.
-    for attempt in (1, 2):
+    for attempt in (1, 2) if device_alive else ():
         t0 = time.monotonic()
         _progress(f"device attempt {attempt} (timeout {timeout_s:.0f}s)")
         got = _run_child(timeout_s, reduced if ambient_cpu else None)
@@ -632,7 +678,9 @@ def main() -> None:
         _progress("falling back to JAX_PLATFORMS=cpu at reduced scale")
         got = _run_child(timeout_s, {"JAX_PLATFORMS": "cpu", **reduced})
         if isinstance(got, dict):
-            got["error"] = ("device attempts failed, cpu-backend fallback "
+            what = ("device attempts failed" if device_alive
+                    else "device probe failed (no measurement attempted)")
+            got["error"] = (f"{what}, cpu-backend fallback "
                             "at reduced scale: " + " | ".join(errors))[:500]
             result = got
         else:
