@@ -115,6 +115,46 @@ def _encode_location_stream(ids: np.ndarray, mids: np.ndarray,
     return out, offs
 
 
+# Profile.sample_type for every profile is the same two-entry message
+# over string indices 1 ("samples") and 2 ("count") — constant bytes.
+_SAMPLE_TYPE_SEC = bytes([
+    (P_SAMPLE_TYPE << 3) | 2, 4,
+    (VT_TYPE << 3), 1, (VT_UNIT << 3), 2,
+])
+
+
+def _encode_mapping_stream(mids, starts, limits, offsets, fidx, bidx):
+    """Vectorized Profile.mapping messages for a flat stream of rows
+    (many pids' tables concatenated; string indices are per-pid values the
+    caller computed while interning). Zero-valued fields are elided,
+    matching proto.put_tag_varint. Returns (uint8 buffer, int64 per-row
+    offsets [N+1])."""
+    cols = [np.ascontiguousarray(c, np.uint64)
+            for c in (mids, starts, limits, offsets, fidx, bidx)]
+    n = len(cols[0])
+    lens = [varint_len(c) for c in cols]
+    present = [c > 0 for c in cols]
+    body = np.zeros(n, np.int64)
+    for c_len, c_has in zip(lens, present):
+        body += np.where(c_has, 1 + c_len, 0)
+    l_body = varint_len(body.astype(np.uint64))
+    msg = 1 + l_body + body
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(msg, out=offs[1:])
+    out = np.empty(int(offs[-1]), np.uint8)
+    p = offs[:-1].copy()
+    out[p] = (P_MAPPING << 3) | 2
+    put_varints(out, p + 1, body.astype(np.uint64), l_body)
+    p += 1 + l_body
+    for field, (col, c_len, c_has) in enumerate(
+            zip(cols, lens, present), start=1):
+        sel = p[c_has]
+        out[sel] = (field << 3)
+        put_varints(out, sel + 1, col[c_has], c_len[c_has])
+        p += np.where(c_has, 1 + c_len, 0)
+    return out, offs
+
+
 class _PidStatic:
     """Cached per-pid static sections of the profile message."""
 
@@ -315,24 +355,78 @@ class WindowEncoder:
             self._static_gen += 1
         return st
 
+    def _build_head_tail_batch(self, items, period_ns: int) -> None:
+        """Batch head/tail build: Python only interns the (few) mapping
+        strings and frames the string table per pid; ALL mapping messages
+        across the batch encode in one vectorized pass (the scalar path's
+        per-message Writer varints dominated the 50k-pid first build)."""
+        mid: list[int] = []
+        start: list[int] = []
+        limit: list[int] = []
+        off: list[int] = []
+        fidx: list[int] = []
+        bidx: list[int] = []
+        bounds = [0]
+        tails: list[bytes] = []
+        for _st, reg in items:
+            strings = _Strings()
+            strings("samples")
+            strings("count")
+            for m in reg.mappings:
+                mid.append(m.id)
+                start.append(m.start)
+                limit.append(m.end)
+                off.append(m.offset)
+                fidx.append(strings(m.path))
+                bidx.append(strings(m.build_id))
+            bounds.append(len(mid))
+            pt = proto.Writer().varint(VT_TYPE, strings("cpu")) \
+                .varint(VT_UNIT, strings("nanoseconds"))
+            tail = bytearray()
+            for s_ in strings.table:
+                proto.put_tag_bytes(tail, P_STRING_TABLE, s_.encode())
+            proto.put_tag_bytes(tail, P_PERIOD_TYPE, bytes(pt.buf))
+            proto.put_tag_varint(tail, P_PERIOD, period_ns)
+            tails.append(bytes(tail))
+        if mid:
+            buf, offs = _encode_mapping_stream(mid, start, limit, off,
+                                               fidx, bidx)
+            mv = buf.data
+        # Mark pids clean only now, with head AND tail in hand: a raise
+        # above (e.g. MemoryError in the stream encode) must leave every
+        # staleness guard still tripping so a retry rebuilds fully.
+        for k, (st, reg) in enumerate(items):
+            if mid:
+                a, b = int(offs[bounds[k]]), int(offs[bounds[k + 1]])
+                st.head = _SAMPLE_TYPE_SEC + bytes(mv[a:b])
+            else:
+                st.head = _SAMPLE_TYPE_SEC
+            st.tail = tails[k]
+            st.period_ns = period_ns
+            st.n_mappings = len(reg.mappings)
+        self._static_gen += 1
+
     def build_statics(self, period_ns: int) -> int:
         """Pre-build every known pid's static sections in ONE vectorized
-        location pass (the per-pid _ensure_static path pays a vectorization
-        fixed cost per pid — ruinous for the 50k-pid first window). Returns
-        the number of pids now cached. Steady-state encodes then touch only
-        changed pids."""
+        location pass and ONE vectorized mapping pass (the per-pid
+        _ensure_static path pays a vectorization fixed cost per pid —
+        ruinous for the 50k-pid first window). Returns the number of pids
+        now cached. Steady-state encodes then touch only changed pids."""
         self._sync()
         agg = self._agg
         dirty: list[tuple[_PidStatic, object, int]] = []
+        dirty_ht: list[tuple[_PidStatic, object]] = []
         for pid, reg in agg._pids.items():
             st = self._static.get(pid)
             if st is None:
                 st = self._static[pid] = _PidStatic()
             if st.n_mappings != len(reg.mappings) \
                     or st.period_ns != period_ns:
-                self._build_head_tail(st, reg, period_ns)
+                dirty_ht.append((st, reg))
             if st.n_locs < len(reg.loc_address):
                 dirty.append((st, reg, len(reg.loc_address)))
+        if dirty_ht:
+            self._build_head_tail_batch(dirty_ht, period_ns)
         if dirty:
             ids = [np.arange(st.n_locs + 1, n + 1, dtype=np.uint64)
                    for st, reg, n in dirty]

@@ -127,9 +127,20 @@ def test_encoder_matches_builder_single_window():
     agg = DictAggregator(capacity=1 << 12)
     enc = WindowEncoder(agg)
     counts = agg.window_counts(snap)
+    # Route statics through the BATCH build (the first-window warm path;
+    # one vectorized mapping pass) so the differential covers it too.
+    enc.build_statics(snap.period_ns)
     out = enc.encode(counts, snap.time_ns, snap.window_ns, snap.period_ns)
     assert len(out) > 1
     _assert_same_profiles(agg, snap, counts, out)
+
+    # The straggler path (_ensure_static, scalar build) must produce the
+    # same bytes as the batch build for the same registry state.
+    enc2 = WindowEncoder(agg)
+    out2 = enc2.encode(counts, snap.time_ns, snap.window_ns, snap.period_ns)
+    assert len(out) == len(out2)
+    for (p1, b1), (p2, b2) in zip(out, out2):
+        assert p1 == p2 and b1 == b2
 
 
 def test_encoder_incremental_new_stacks_and_pids():
