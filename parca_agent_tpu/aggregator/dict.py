@@ -57,73 +57,10 @@ from parca_agent_tpu.ops.hashing import row_hash_np
 _PROBES = 16
 
 
-def make_lookup(cap: int, id_cap: int, n_pad: int):
-    """Pure (unjitted) batched-lookup window program; _lookup_program
-    jits it. (The driver entry point compile-checks make_feed, the same
-    probe loop with accumulate semantics; this one-shot variant is
-    exercised by the sync phase and its tests.)"""
-    import jax
-    import jax.numpy as jnp
-
-    def lookup(table, packed):
-        # table:  uint32 [cap, 4] rows of h1 | h2 | h3 | id+1 (0 = empty) —
-        #         ONE row-gather per probe step instead of five.
-        # packed: uint32 [4, n_pad] rows of h1 | h2 | h3 | counts —
-        #         ONE host->device buffer per window (round-trip latency
-        #         dominates at these sizes, so operand count matters more
-        #         than bytes).
-        h1, h2, h3 = packed[0], packed[1], packed[2]
-        cnt = packed[3].astype(jnp.int32)
-        mask = jnp.uint32(cap - 1)
-
-        def probe(k, state):
-            found_id, done = state
-            idx = ((h1 + jnp.uint32(k)) & mask).astype(jnp.int32)
-            row = table[idx]  # [n, 4]
-            occ = row[:, 3] > 0
-            hit = occ & (row[:, 0] == h1) & (row[:, 1] == h2) \
-                & (row[:, 2] == h3)
-            # An empty slot ends this key's probe chain: definitive miss.
-            stop = hit | ~occ
-            found_id = jnp.where(hit & ~done,
-                                 row[:, 3].astype(jnp.int32) - 1, found_id)
-            return found_id, done | stop
-
-        found_id = jnp.full(h1.shape, -1, jnp.int32)
-        done = jnp.zeros(h1.shape, bool)
-        found_id, _ = jax.lax.fori_loop(0, _PROBES, probe, (found_id, done))
-
-        live = cnt > 0
-        hit = (found_id >= 0) & live
-        counts = jnp.zeros((id_cap,), jnp.int32).at[
-            jnp.where(hit, found_id, id_cap)
-        ].add(cnt, mode="drop")
-        miss = live & ~hit
-        # Compact miss row indices into a fixed [n_pad] buffer.
-        mtgt = jnp.where(miss, jnp.cumsum(miss.astype(jnp.int32)) - 1,
-                         jnp.int32(n_pad))
-        miss_rows = jnp.full((n_pad,), -1, jnp.int32).at[mtgt].set(
-            jnp.arange(h1.shape[0], dtype=jnp.int32), mode="drop")
-        n_miss = miss.astype(jnp.int32).sum()
-        # counts + n_miss ride ONE device->host buffer; miss_rows is only
-        # fetched when n_miss > 0 (never, in steady state).
-        out = jnp.concatenate([counts, n_miss[None]])
-        return out, miss_rows
-
-    return lookup
-
-
-@functools.lru_cache(maxsize=4)
-def _lookup_program(cap: int, id_cap: int, n_pad: int):
-    import jax
-
-    return jax.jit(make_lookup(cap, id_cap, n_pad), donate_argnums=())
-
-
 def make_feed(cap: int, id_cap: int, n_pad: int):
-    """Pure (unjitted) streaming-window accumulate: like make_lookup but
-    scatter-adds into a persistent device accumulator instead of a fresh
-    counts buffer.
+    """Pure (unjitted) streaming-window accumulate: batched linear-probe
+    lookup of all rows against the device stack dictionary, scatter-adding
+    hits into a persistent device accumulator.
 
     The TPU-native answer to the reference's in-kernel accumulation (its
     BPF stack_counts map absorbs samples DURING the window so window close
@@ -366,37 +303,23 @@ class DictAggregator:
     def window_counts(self, snapshot: WindowSnapshot,
                       hashes=None) -> np.ndarray:
         """The aggregation core: int64 counts indexed by stack id
-        (length == number of stacks known after this window)."""
-        import jax.numpy as jnp
+        (length == number of stacks known after this window).
 
-        n = len(snapshot)
-        if n == 0:
+        One-shot semantics over the SAME feed/close programs the streaming
+        protocol uses (a separate lookup program would be one more tunnel
+        compile for an 8 MB unpacked fetch; feed + packed close ships the
+        window once and fetches ~0.6 MB). Any partially-fed open window is
+        discarded first — callers don't mix the two protocols mid-window.
+        Id assignment order matches the miss order of a single whole-window
+        feed, so results are deterministic for a given snapshot."""
+        if len(snapshot) == 0:
             return np.zeros(self._next_id, np.int64)
-        if int(snapshot.counts.sum()) >= 2**31:
-            raise ValueError("window sample total exceeds int32")
-        self._maybe_rotate()  # window boundary: safe to recycle cold ids
-        h1, h2, h3 = hashes if hashes is not None else self.hash_rows(snapshot)
-        counts_f, corrections = self._prefilter_unreachable(
-            h1, h2, h3, snapshot.counts.astype(np.uint32))
-        n_pad = 1 << max(4, (n - 1).bit_length())
-        packed = np.zeros((4, n_pad), np.uint32)
-        packed[0, :n], packed[1, :n], packed[2, :n] = h1, h2, h3
-        packed[3, :n] = counts_f
-
-        self._ensure_device()
-        host_out, miss_rows = self._lookup_dispatch(packed, n_pad)
-        n_miss = int(host_out[-1])
-        out = host_out[:-1].astype(np.int64)
-
-        if n_miss:
-            rows = np.asarray(miss_rows)[:n_miss]
-            out = self._handle_misses(snapshot, rows, h1, h2, h3, out)
-        for sid, cnt in corrections:
-            out[sid] += cnt
-        self.stats["windows"] += 1
-        result = out[: self._next_id]
-        self._last_seen[np.flatnonzero(result)] = self.stats["windows"]
-        return result
+        if self._fed_total or self._pending:
+            self._fed_total = 0
+            self._pending = []
+        self._needs_reset = True
+        self.feed(snapshot, hashes)
+        return self.close_window(copy=True)
 
     # -- streaming window protocol -------------------------------------------
     #
@@ -491,15 +414,6 @@ class DictAggregator:
         if not nm:
             return np.empty(0, np.int64)
         return np.asarray(miss_rows)[:nm].astype(np.int64)
-
-    def _lookup_dispatch(self, packed: np.ndarray, n_pad: int):
-        """Run the one-shot lookup program; returns (host buffer of
-        counts+n_miss, device miss-row buffer)."""
-        import jax.numpy as jnp
-
-        prog = _lookup_program(self._cap, self._id_cap, n_pad)
-        dev_out, miss_rows = prog(self._dev, jnp.asarray(packed))
-        return np.asarray(dev_out), miss_rows
 
     def _close_fetch(self, n_fetch: int, width: int,
                      n_over_buf: int) -> np.ndarray:
@@ -732,16 +646,6 @@ class DictAggregator:
             table[:, 3] = np.where(self._occ, self._ids + 1, 0).astype(np.uint32)
             self._dev = jnp.asarray(table)
 
-    def _handle_misses(self, snapshot, rows, h1, h2, h3,
-                       out: np.ndarray) -> np.ndarray:
-        pending = self._resolve_misses(snapshot, rows, h1, h2, h3)
-        if pending:
-            # `out` is the device scatter buffer, always [id_cap]-long.
-            sids = np.array([p[0] for p in pending], np.int64)
-            cnts = np.array([p[1] for p in pending], np.int64)
-            np.add.at(out, sids, cnts)
-        return out
-
     def _resolve_misses(self, snapshot, rows, h1, h2, h3
                         ) -> list[tuple[int, int]]:
         """Absorb device-miss rows: insert genuinely new stacks (host mirror
@@ -859,7 +763,7 @@ class DictAggregator:
         return self._host_insert_slot(key)
 
     def _host_insert_slot(self, key: tuple) -> int:
-        # Capacity was validated batch-wide by _handle_misses.
+        # Capacity was validated batch-wide by _resolve_misses.
         mask = self._cap - 1
         idx = key[0] & mask
         # Unbounded on host (correctness); a key landing beyond the device

@@ -259,22 +259,3 @@ class ShardedDictAggregator(DictAggregator):
         self._dev = self._dev.at[jnp.asarray(s_idx), jnp.asarray(w_idx)].set(
             jnp.asarray(vals))
 
-    # -- one-shot paths ride the streaming protocol ---------------------------
-
-    def window_counts(self, snapshot, hashes=None) -> np.ndarray:
-        if len(snapshot) == 0:
-            return np.zeros(self._next_id, np.int64)
-        if self._fed_total or self._pending:
-            # One-shot semantics: any partially-fed window is discarded
-            # (the single-chip lookup path leaves streaming state alone;
-            # here both ride the same accumulator, so be explicit).
-            self._fed_total = 0
-            self._pending = []
-        self._needs_reset = True
-        self.feed(snapshot, hashes)
-        return self.close_window(copy=True)
-
-    def _lookup_dispatch(self, packed, n_pad):  # pragma: no cover
-        raise NotImplementedError(
-            "sharded aggregation has no one-shot lookup program; "
-            "window_counts rides feed/close")
