@@ -91,6 +91,24 @@ def test_sharded_capacity_validation(mesh):
         ShardedDictAggregator(capacity=(1 << 13) + 8, mesh=mesh)
 
 
+def test_sharded_with_window_encoder(mesh):
+    """The template encoder reads the host mirror, which the sharded
+    aggregator shares with the single-chip dict — the pairing must produce
+    oracle-equal profiles."""
+    from parca_agent_tpu.pprof.builder import parse_pprof
+    from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+
+    snap = generate(_spec(seed=6, n_pids=10, rows=400))
+    agg = ShardedDictAggregator(capacity=1 << 13, mesh=mesh)
+    enc = WindowEncoder(agg)
+    counts = agg.window_counts(snap)
+    out = enc.encode(counts, snap.time_ns, snap.window_ns, snap.period_ns)
+    oracle = {p.pid: p.total() for p in CPUAggregator().aggregate(snap)}
+    got = {pid: sum(v[0] for _, v, _ in parse_pprof(b).samples)
+           for pid, b in out}
+    assert got == oracle
+
+
 def test_sharded_subtable_overflow_is_bounded(mesh):
     """A skewed h2 distribution can fill ONE sub-table while the global
     capacity check still passes; insertion must degrade (sketch) or raise
