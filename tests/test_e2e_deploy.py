@@ -12,10 +12,13 @@ ends up with non-empty, pod-labeled series.
 
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
 import pytest
+
+from parca_agent_tpu.config import load_config
 
 from parca_agent_tpu.capture.formats import (
     MappingTable,
@@ -61,6 +64,28 @@ def test_manifest_structure_is_deployable():
     vols = {v["name"] for v in spec["volumes"]}
     for m in c["volumeMounts"]:
         assert m["name"] in vols, m
+
+
+def test_kustomization_references_real_resources():
+    with open("deploy/kustomization.yaml") as f:
+        k = yaml.safe_load(f)
+    for r in k["resources"]:
+        assert os.path.exists(os.path.join("deploy", r)), r
+    # The generated ConfigMap must be the one the DaemonSet mounts, and
+    # its config.yaml content must be loadable by the agent's config
+    # parser.
+    gen = k["configMapGenerator"][0]
+    ds = _docs()["DaemonSet"]["spec"]["template"]["spec"]
+    cfg_vols = [v for v in ds["volumes"] if "configMap" in v]
+    assert gen["name"] in {v["configMap"]["name"] for v in cfg_vols}
+    lit = dict(x.split("=", 1) for x in gen["literals"])
+    # The generated key must be the very filename the container reads
+    # (--config-path basename); a key rename would otherwise silently
+    # boot the agent without its relabel config (the volume is optional).
+    cfg_arg = next(a for a in _container(_docs())["args"]
+                   if a.startswith("--config-path="))
+    assert os.path.basename(cfg_arg.split("=", 1)[1]) in lit
+    assert load_config(lit["config.yaml"]).relabel_configs == []
 
 
 def _manifest_args():
