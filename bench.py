@@ -205,8 +205,8 @@ def run(emit=None) -> dict:
     unable to lose the headline. To the same end the CPU baseline
     (numpy-only) runs FIRST, before any device compile, and the
     population insert rides the feed path so only the feed+close
-    programs compile before the headline exists (the one-shot lookup
-    program compiles later, in the sync phase)."""
+    programs compile before the headline exists (window_counts now rides
+    the same programs, so the sync phase adds no compile at all)."""
     extras: dict = {}
     rows = int(os.environ.get("PARCA_BENCH_ROWS", 1 << 20))
     pids = int(os.environ.get("PARCA_BENCH_PIDS", 50_000))
@@ -277,8 +277,7 @@ def run(emit=None) -> dict:
     chunk = 1 << 17  # one capture drain's worth of rows per feed
     # First window rides the FEED path (population insert through the
     # feed-miss protocol): only the feed program compiles here, matching
-    # production (capture drains insert; the one-shot lookup program isn't
-    # needed until the sync phase, well after the headline).
+    # production (capture drains insert).
     _progress("first window (feed-path compile + insert population)")
     for lo in range(0, rows, chunk):
         agg.feed(snap, hashes, lo, min(lo + chunk, rows))
@@ -417,8 +416,9 @@ def run(emit=None) -> dict:
             extras["pprof_error"] = repr(e)[:200]
         _emit_partial()
 
-    # Fully-synchronous one-shot boundary, for reference (compiles the
-    # lookup program — intentionally after the headline + pprof phases).
+    # Fully-synchronous one-shot boundary, for reference (rides the same
+    # feed + packed-close programs; n_pad differs, so the whole-window
+    # feed shape may compile here — intentionally after the headline).
     if _budget_left(0.15, "sync_oneshot"):
         try:
             t0 = time.perf_counter()
