@@ -15,8 +15,9 @@ Properties checked on every trial:
   * when nothing was absorbed, per-pid profiles equal the CPU oracle's;
   * overflow="raise" only ever raises (never silently corrupts).
 
-A 300-seed sweep of this generator ran clean during development; CI
-keeps a bounded slice so the suite stays fast.
+A 300-seed sweep of this generator ran clean during development (plus
+a 150-seed dict + 40-seed sharded sweep in round 4); CI keeps a bounded
+slice so the suite stays fast.
 """
 
 import numpy as np
@@ -26,7 +27,7 @@ from parca_agent_tpu.aggregator.dict import DictAggregator
 from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
 
 
-def _trial(seed: int) -> None:
+def _trial(seed: int, sharded: bool = False) -> None:
     rng = np.random.default_rng(seed)
     n_pids = int(rng.integers(1, 40))
     uniq = int(rng.integers(1, 3000))
@@ -60,8 +61,18 @@ def _trial(seed: int) -> None:
         rng.integers(cap_lo, 18))
     cap = 1 << cap_exp
     overflow = "sketch" if rng.random() < 0.7 else "raise"
-    d = DictAggregator(capacity=cap, overflow=overflow,
-                       rotate_min_age=1)
+    if sharded:
+        from parca_agent_tpu.aggregator.sharded import ShardedDictAggregator
+        from parca_agent_tpu.parallel.mesh import fleet_mesh
+
+        # 8 virtual devices (conftest); per-shard sub-tables need >= 16
+        # slots for the fuzz's smallest capacities to stay meaningful.
+        cap = max(cap, 1 << 7)
+        d = ShardedDictAggregator(capacity=cap, overflow=overflow,
+                                  rotate_min_age=1, mesh=fleet_mesh(8))
+    else:
+        d = DictAggregator(capacity=cap, overflow=overflow,
+                           rotate_min_age=1)
 
     for w_i, snap in enumerate(windows):
         absorbed_before = d.stats.get("sketch_samples", 0)
@@ -92,6 +103,14 @@ def _trial(seed: int) -> None:
                 assert np.array_equal(np.sort(mp.values),
                                       np.sort(op.values)), (seed, w_i, op.pid)
                 assert mp.total() == op.total()
+
+
+def test_sharded_differential_fuzz_slice():
+    """Same generator/properties over the mesh-sharded aggregator (its
+    per-shard placement + psum close must hold every exactness and
+    degradation property the single-chip dict holds)."""
+    for seed in range(6):
+        _trial(seed, sharded=True)
 
 
 def test_dict_differential_fuzz_slice():
