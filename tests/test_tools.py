@@ -38,3 +38,25 @@ def test_snapshot_summary_renders_kernel_only_stacks():
     out = format_summary(snap)
     assert "0xffff800000000000" in out
     assert "(+1)" in out  # 5 frames, 4 shown
+
+
+def test_pprof_dump(tmp_path, capsys):
+    from parca_agent_tpu.aggregator.cpu import CPUAggregator
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+    from parca_agent_tpu.pprof.builder import build_pprof
+    from parca_agent_tpu.tools.pprof_dump import main as dump_main
+
+    snap = generate(SyntheticSpec(n_pids=2, n_unique_stacks=30,
+                                  total_samples=200, seed=4))
+    prof = CPUAggregator().aggregate(snap)[0]
+    path = tmp_path / "p.pb.gz"
+    path.write_bytes(build_pprof(prof, compress=True))
+    assert dump_main([str(path), "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "sample_types: [('samples', 'count')]" in out
+    assert f"{prof.total()} total" in out
+    assert "top 5 stacks:" in out
+    # Uncompressed input works too.
+    path2 = tmp_path / "p.pb"
+    path2.write_bytes(build_pprof(prof, compress=False))
+    assert dump_main([str(path2)]) == 0
