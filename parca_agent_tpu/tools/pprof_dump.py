@@ -11,6 +11,7 @@ Run: python -m parca_agent_tpu.tools.pprof_dump FILE [--top N]
 from __future__ import annotations
 
 import argparse
+import gzip
 
 from parca_agent_tpu.pprof.builder import ParsedProfile, parse_pprof
 
@@ -65,7 +66,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     with open(args.file, "rb") as f:
         data = f.read()
-    # parse_pprof sniffs and handles gzip itself.
+    # parse_pprof sniffs one gzip layer itself; peel any extras here
+    # (files written before the double-gzip fix carry two layers).
+    while data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
     print(format_profile(parse_pprof(data), top=args.top))
     return 0
 
