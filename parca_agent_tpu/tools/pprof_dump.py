@@ -70,7 +70,10 @@ def main(argv=None) -> int:
     # (files written before the double-gzip fix carry two layers).
     while data[:2] == b"\x1f\x8b":
         data = gzip.decompress(data)
-    print(format_profile(parse_pprof(data), top=args.top))
+    try:
+        print(format_profile(parse_pprof(data), top=args.top))
+    except BrokenPipeError:
+        pass  # piped into head; normal CLI etiquette
     return 0
 
 
