@@ -223,6 +223,25 @@ def decode_records_columnar_v1d(lib, buf, nbytes: int) -> tuple:
     return pids, tids, ulen, klen, stacks, counts
 
 
+def mapping_table_for_pids(maps_cache, objs_cache, pids) -> MappingTable:
+    """MappingTable for a set of pids via the shared caches; pids that
+    exited (maps unreadable) or are unattributable (< 0) are skipped —
+    their rows keep raw addresses. Shared by the window-end snapshot
+    build and the streaming feeder's per-drain mini-snapshots so the two
+    paths cannot drift."""
+    per_pid = {}
+    for pid in pids:
+        pid = int(pid)
+        if pid < 0:
+            continue
+        try:
+            per_pid[pid] = maps_cache.executable_mappings(pid)
+        except OSError:
+            continue
+    return build_mapping_table(per_pid, objs_cache.build_ids(per_pid),
+                               objcache=objs_cache)
+
+
 def columns_to_snapshot(
     pids, tids, ulen, klen, stacks,
     mappings: MappingTable, period_ns: int, window_ns: int,
@@ -539,6 +558,12 @@ class PerfEventSampler:
         # bytes are ever read back.
         self._drainbuf = (ctypes.c_uint8 * self._cap)()
         self._final_counters = (0, 0, 0)  # (lost, truncated, dedup) at close
+        # Optional per-drain tee (FP mode): called on the polling thread
+        # with each drain's columnar chunk so a streaming consumer (the
+        # window feeder) can ship it to the aggregation device DURING the
+        # window. A failing tee disables itself for the agent's lifetime
+        # (the window-end snapshot path is unaffected either way).
+        self.on_drain = None
         self.capture_stack = capture_stack
         flags = PA_CAPTURE_USER_STACK if capture_stack else 0
         self._handle = self._lib.pa_sampler_create2(
@@ -648,7 +673,18 @@ class PerfEventSampler:
                                    trust_fp_frames=self._trust_fp_frames,
                                    stats=self.walk_stats))
             else:
-                col_chunks.extend(self._drain_columnar())
+                chunks = self._drain_columnar()
+                col_chunks.extend(chunks)
+                if self.on_drain is not None:
+                    for c in chunks:
+                        try:
+                            self.on_drain(c)
+                        except Exception as e:  # noqa: BLE001 - tee only
+                            _log.warn("on_drain tee failed; disabling "
+                                      "streaming for this agent",
+                                      error=repr(e))
+                            self.on_drain = None
+                            break
 
         if self.capture_stack:
             pid_iter = sorted({r[0] for r in records})
@@ -661,14 +697,7 @@ class PerfEventSampler:
                         np.zeros((0, STACK_SLOTS), np.uint64),
                         np.zeros(0, np.int64)))]
             pid_iter = np.unique(cols[0]).tolist()
-        per_pid = {}
-        for pid in pid_iter:
-            try:
-                per_pid[pid] = self._maps.executable_mappings(pid)
-            except OSError:
-                continue
-        table = build_mapping_table(per_pid, self._objs.build_ids(per_pid),
-                                    objcache=self._objs)
+        table = mapping_table_for_pids(self._maps, self._objs, pid_iter)
         period_ns = int(1e9 / self._freq)
         window_ns = int(self._window * 1e9)
         if self.capture_stack:

@@ -55,6 +55,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "vectorized template encoder and ship profiles "
                         "unsymbolized (the server symbolizes, as with the "
                         "reference agent); disables local symbolization")
+    p.add_argument("--streaming-window", action="store_true",
+                   help="feed each capture drain to the aggregation device "
+                        "DURING the window (perf capture + dict aggregator "
+                        "+ --fast-encode); window close is then one packed "
+                        "fetch. Device trouble self-disables back to the "
+                        "one-shot path; exactness is checked per window")
     p.add_argument("--fleet-coordinator", default="",
                    help="host:port of fleet node 0; joining forms the "
                         "cross-host device mesh (jax.distributed) and "
@@ -361,6 +367,23 @@ def run(argv=None) -> int:
 
     if args.fast_encode and not hasattr(aggregator, "window_counts"):
         raise SystemExit("--fast-encode requires --aggregator dict/dict+cm")
+    feeder = None
+    if args.streaming_window:
+        if not (args.fast_encode and hasattr(aggregator, "feed")):
+            raise SystemExit("--streaming-window requires --fast-encode "
+                             "and a dict aggregator")
+        if not (hasattr(source, "on_drain") and not getattr(
+                source, "capture_stack", False)):
+            log.warn("--streaming-window needs the perf capture source in "
+                     "FP mode; running one-shot")
+        else:
+            from parca_agent_tpu.profiler.streaming import (
+                StreamingWindowFeeder,
+            )
+
+            feeder = StreamingWindowFeeder(aggregator, source._maps,
+                                           source._objs)
+            source.on_drain = feeder.on_drain
     profiler = CPUProfiler(
         source=source,
         aggregator=aggregator,
@@ -377,6 +400,7 @@ def run(argv=None) -> int:
         manage_gc=True,
         window_sink=window_sink,
         fast_encode=args.fast_encode,
+        streaming_feeder=feeder,
     )
 
     # -- HTTP ----------------------------------------------------------------
@@ -393,6 +417,12 @@ def run(argv=None) -> int:
         labels = ",".join(f'{k}="{v}"'
                           for k, v in binfo.as_metrics().items())
         out[f"parca_agent_build_info{{{labels}}}"] = 1
+        if feeder is not None:
+            out["parca_agent_streaming_disabled"] = int(feeder.disabled)
+            for k, v in feeder.stats.items():
+                if isinstance(v, (int, float)):
+                    out[f"parca_agent_streaming_{k}"] = round(v, 4) \
+                        if isinstance(v, float) else v
         if fleet_merger is not None:
             if fleet_merger.failed is not None:
                 # Fleet mode is dead (SPMD peer loss): surface THAT, not

@@ -65,6 +65,7 @@ class CPUProfiler:
         manage_gc: bool = False,
         window_sink: Callable[[WindowSnapshot], None] | None = None,
         fast_encode: bool = False,
+        streaming_feeder=None,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -86,6 +87,12 @@ class CPUProfiler:
             from parca_agent_tpu.pprof.window_encoder import WindowEncoder
 
             self._encoder = WindowEncoder(aggregator)
+        # Streaming mode: drains were fed to the device during the window
+        # (profiler/streaming.py); close replaces the one-shot aggregate
+        # when the feeder confirms it saw the whole window.
+        if streaming_feeder is not None and self._encoder is None:
+            raise ValueError("streaming_feeder requires fast_encode")
+        self._feeder = streaming_feeder
         self._fallback = fallback_aggregator
         self._device_timeout = device_timeout_s
         self._device_retry_windows = device_retry_windows
@@ -336,7 +343,18 @@ class CPUProfiler:
         self._windows_seen += 1  # hang-cooldown clock (obtain_profiles' twin)
 
         def fast():
-            counts = self._aggregator.window_counts(snapshot)
+            if self._feeder is not None and self._feeder.device_blocked():
+                # An abandoned streaming feed may still be executing
+                # inside the aggregator; touching it now would race the
+                # donation contract. Raise into the watchdog machinery:
+                # the CPU fallback shares no state with the dict.
+                raise RuntimeError(
+                    "abandoned streaming feed still in flight")
+            counts = None
+            if self._feeder is not None:
+                counts = self._feeder.take_window_if_complete(snapshot)
+            if counts is None:  # not streamed (or incomplete): one-shot
+                counts = self._aggregator.window_counts(snapshot)
             return "enc", self._encoder.encode(
                 counts, snapshot.time_ns, snapshot.window_ns,
                 snapshot.period_ns)

@@ -1,0 +1,152 @@
+"""Streaming window feeder: ship capture drains to the aggregation device
+DURING the window.
+
+This is the production realization of the boundary the bench measures
+(bench.py "steady-state close"): the reference's BPF map absorbs samples
+in kernel as they happen (bpf/cpu/cpu.bpf.c:110-116), so its window close
+never re-ships the window; here each once-a-second drain is fed to the
+dict aggregator's device table as it lands (H2D + the probe/accumulate
+kernel ride the otherwise-idle window), and the profiler's window close
+is just close_window() — one pack kernel, one packed fetch.
+
+Safety model (SURVEY.md section 7 hard part #5 — device trouble must not
+stall the capture loop):
+
+  * Every feed runs under a daemon-thread watchdog with a SHORT timeout
+    (the polling thread is stalled while a feed runs; perf rings are
+    smaller than a window, so a long stall wraps them and loses samples).
+    A failure or hang PERMANENTLY disables the feeder — feeding a wedged
+    device would stall the polling thread again next drain.
+  * An abandoned (timed-out) feed may still be EXECUTING inside the
+    aggregator. Until it actually returns, the aggregator must not be
+    touched from any other thread: device_blocked() reports this, and
+    the profiler's one-shot path raises into its own watchdog/fallback
+    machinery instead of racing the abandoned call (the CPU fallback
+    aggregator shares no state with the dict).
+  * At window close the fed mass is checked against the snapshot's total;
+    any mismatch (a feed died mid-window, a drain raced the boundary)
+    discards the fed accumulator and re-aggregates the full snapshot
+    one-shot — exactness never depends on the streaming path.
+
+The drain tee and the window boundary both run on the profiler thread
+(the sampler's poll() invokes the tee synchronously); only the watchdog
+helper threads are extra, and they never mutate feeder state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from parca_agent_tpu.capture.formats import WindowSnapshot
+from parca_agent_tpu.capture.live import (
+    columns_to_snapshot,
+    mapping_table_for_pids,
+)
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("streaming")
+
+
+class StreamingWindowFeeder:
+    """Per-drain feed glue between a LiveSampler (FP mode) and a
+    DictAggregator. Wire `sampler.on_drain = feeder.on_drain` and pass
+    the feeder to CPUProfiler(streaming_feeder=...)."""
+
+    def __init__(self, aggregator, maps_cache, objs_cache,
+                 feed_timeout_s: float = 3.0):
+        self._agg = aggregator
+        self._maps = maps_cache
+        self._objs = objs_cache
+        self._timeout = feed_timeout_s
+        self._fed_total = 0          # mass fed into the open window
+        self._inflight: threading.Event | None = None  # abandoned feed
+        self.disabled = False        # permanent (device trouble)
+        self.stats = {"drains_fed": 0, "windows_streamed": 0,
+                      "windows_fallback": 0, "last_close_s": 0.0}
+
+    def device_blocked(self) -> bool:
+        """True while an abandoned feed may still be executing inside the
+        aggregator (nothing else may touch it until then)."""
+        if self._inflight is None:
+            return False
+        if self._inflight.is_set():
+            self._inflight = None
+            return False
+        return True
+
+    # -- drain tee (called inside sampler.poll on the profiler thread) -------
+
+    def on_drain(self, cols) -> None:
+        if self.disabled:
+            return
+        import numpy as np
+
+        pids, tids, ulen, klen, stacks, counts = cols
+        if not len(pids):
+            return
+        table = mapping_table_for_pids(self._maps, self._objs,
+                                       np.unique(pids).tolist())
+        mini = columns_to_snapshot(pids, tids, ulen, klen, stacks,
+                                   table, 0, 0, weights=counts)
+        if len(mini) == 0:
+            return
+        if not self._feed_guarded(mini):
+            # Do NOT try again this agent: a wedged device would stall
+            # the capture loop on every subsequent drain.
+            self.disabled = True
+            _log.warn("streaming feed failed; reverting to one-shot "
+                      "window aggregation permanently")
+            return
+        self._fed_total += mini.total_samples()
+        self.stats["drains_fed"] += 1
+
+    def _feed_guarded(self, mini: WindowSnapshot) -> bool:
+        box: dict = {}
+        done = threading.Event()
+
+        def call():
+            try:
+                self._agg.feed(mini)
+                box["ok"] = True
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                box["err"] = e
+            finally:
+                done.set()
+
+        threading.Thread(target=call, name="stream-feed",
+                         daemon=True).start()
+        if not done.wait(self._timeout):
+            # Abandoned: the call may still be mutating the aggregator.
+            self._inflight = done
+            _log.error("streaming feed hung; abandoning",
+                       timeout_s=self._timeout)
+            return False
+        if "err" in box:
+            _log.warn("streaming feed error", error=repr(box["err"]))
+            return False
+        return True
+
+    # -- window boundary (profiler iteration) --------------------------------
+
+    def take_window_if_complete(self, snapshot: WindowSnapshot):
+        """If every drain of the window was fed and the fed mass equals
+        the snapshot's, return the closed exact counts; else None (the
+        caller one-shots the snapshot). Either way the feeder is reset
+        for the next window."""
+        fed = self._fed_total
+        self._fed_total = 0
+        if self.disabled:
+            self.stats["windows_fallback"] += 1
+            return None
+        if fed != snapshot.total_samples():
+            # A drain raced the window boundary or a tee was skipped:
+            # exactness rules, stream the next window instead.
+            self.stats["windows_fallback"] += 1
+            self._agg._needs_reset = True  # discard the partial window
+            return None
+        t0 = time.perf_counter()
+        counts = self._agg.close_window(copy=False)
+        self.stats["windows_streamed"] += 1
+        self.stats["last_close_s"] = time.perf_counter() - t0
+        return counts
