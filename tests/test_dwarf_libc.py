@@ -174,3 +174,64 @@ int main() { volatile unsigned x = 1; for (;;) x = spin(x, 20); }
     assert ratio >= 0.90, (ratio, st)
     print(f"dwarf walk success ratio: {ratio:.4f} "
           f"({st.success}/{st.total}, pid {os.getpid()})")
+
+
+@pytest.mark.live
+def test_live_dwarf_cli_end_to_end(tmp_path):
+    """The full agent shell in DWARF mode against a live FP-less burner:
+    written profiles must carry the recovered deep stacks (the whole
+    pipeline — sampler regs/stack capture, async table build, batched
+    walk, aggregation, pprof write — through the real CLI)."""
+    import gzip
+    import os
+    import shutil
+    import subprocess
+
+    from parca_agent_tpu.capture.live import (
+        PerfEventSampler,
+        SamplerUnavailable,
+    )
+    from parca_agent_tpu.cli import run
+    from parca_agent_tpu.pprof.builder import parse_pprof
+
+    try:
+        PerfEventSampler(frequency_hz=99, window_s=0.1).close()
+    except SamplerUnavailable as e:
+        pytest.skip(f"perf_event not permitted here: {e}")
+    gxx = shutil.which("g++") or shutil.which("gcc")
+    if gxx is None:
+        pytest.skip("no C compiler for the burn target")
+    src = tmp_path / "pbburn.cc"
+    src.write_text("""
+__attribute__((noinline)) unsigned spin(unsigned x, int d) {
+  if (d > 0) return spin(x * 1103515245u + 12345u, d - 1);
+  for (int i = 0; i < 1000; i++) x = x * 1103515245u + 12345u;
+  return x;
+}
+int main() { volatile unsigned x = 1; for (;;) x = spin(x, 16); }
+""")
+    binp = tmp_path / "pbburn"
+    r = subprocess.run([gxx, "-O1", "-fomit-frame-pointer", "-o",
+                        str(binp), str(src)], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    burn = subprocess.Popen([str(binp)])
+    out = tmp_path / "profiles"
+    try:
+        rc = run(["--capture", "perf", "--dwarf-unwinding",
+                  "--dwarf-unwinding-comm-regex", "pbburn",
+                  "--profiling-duration", "4", "--windows", "3",
+                  "--local-store-directory", str(out),
+                  "--http-address", "127.0.0.1:0",
+                  "--debuginfo-upload-disable", "--node", "dsoak"])
+    finally:
+        burn.kill()
+    assert rc == 0
+    deep = 0
+    for f in os.listdir(out):
+        if "pbburn" not in f:
+            continue
+        p = parse_pprof(gzip.decompress((out / f).read_bytes()))
+        deep = max(deep, max((len(l) for l, _, _ in p.samples), default=0))
+    # 16 recursion levels + spin leaf + main + libc entry frames: the
+    # FP chain alone cannot exceed ~2 on this binary.
+    assert deep >= 10, deep

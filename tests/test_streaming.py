@@ -177,3 +177,22 @@ def test_profiler_streaming_requires_fast_encode():
     with pytest.raises(ValueError):
         CPUProfiler(source=None, aggregator=CPUAggregator(),
                     streaming_feeder=object())
+
+
+def test_feeder_with_sharded_aggregator():
+    """Streaming inherits over the mesh-sharded dict (same feed/close
+    protocol; the sub-tables and psum close are dispatch details)."""
+    from parca_agent_tpu.aggregator.sharded import ShardedDictAggregator
+    from parca_agent_tpu.parallel.mesh import fleet_mesh
+
+    snap = _snap(seed=7, n=400, pids=8)
+    agg = ShardedDictAggregator(capacity=1 << 12, mesh=fleet_mesh(8))
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs())
+    for lo in range(0, len(snap), 96):
+        feeder.on_drain(_cols(snap, lo, min(lo + 96, len(snap))))
+    counts = feeder.take_window_if_complete(snap)
+    assert counts is not None
+    assert int(counts.sum()) == snap.total_samples()
+    profiles = {p.pid: p.total() for p in agg._build_profiles(snap, counts)}
+    oracle = {p.pid: p.total() for p in CPUAggregator().aggregate(snap)}
+    assert profiles == oracle
