@@ -41,12 +41,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default=10.0)
     p.add_argument("--local-store-directory", default="")
     p.add_argument("--aggregator", default="cpu",
-                   choices=["cpu", "tpu", "dict", "dict+cm"],
+                   choices=["cpu", "tpu", "dict", "dict+cm", "sharded"],
                    help="window aggregation backend (dict = stateful "
                         "device-resident stack dictionary, the TPU "
                         "production mode; dict+cm = bounded-memory dict "
                         "that degrades overflow to a count-min sketch and "
-                        "rotates cold stacks instead of growing)")
+                        "rotates cold stacks instead of growing; sharded "
+                        "= dict+cm semantics with the table + probe work "
+                        "sharded over local devices via shard_map — "
+                        "multi-chip hosts)")
     p.add_argument("--aggregator-capacity", type=int, default=1 << 21,
                    help="dict table slots (power of two); dict+cm keeps "
                         "memory bounded at this size under stack churn")
@@ -252,6 +255,24 @@ def run(argv=None) -> int:
 
         aggregator = TPUAggregator()
         fallback = CPUAggregator()
+    elif args.aggregator == "sharded":
+        import jax
+
+        from parca_agent_tpu.aggregator.sharded import ShardedDictAggregator
+        from parca_agent_tpu.parallel.mesh import fleet_mesh
+
+        # Largest power-of-two device count: sub-tables must be
+        # power-of-two sized, and a 6-device host should shard 4 ways
+        # rather than die at startup.
+        n_dev = len(jax.devices())
+        n_shards = 1 << (n_dev.bit_length() - 1)
+        if n_shards < n_dev:
+            log.warn("sharded aggregator uses a power-of-two shard count",
+                     devices=n_dev, shards=n_shards)
+        aggregator = ShardedDictAggregator(
+            capacity=args.aggregator_capacity, overflow="sketch",
+            mesh=fleet_mesh(n_shards))
+        fallback = CPUAggregator()
     elif args.aggregator in ("dict", "dict+cm"):
         from parca_agent_tpu.aggregator.dict import DictAggregator
 
@@ -366,7 +387,8 @@ def run(argv=None) -> int:
                 snapshot.counts)
 
     if args.fast_encode and not hasattr(aggregator, "window_counts"):
-        raise SystemExit("--fast-encode requires --aggregator dict/dict+cm")
+        raise SystemExit(
+            "--fast-encode requires --aggregator dict/dict+cm/sharded")
     feeder = None
     if args.streaming_window:
         if not (args.fast_encode and hasattr(aggregator, "feed")):

@@ -461,3 +461,27 @@ def test_buildinfo_collects_and_never_raises(monkeypatch):
     m = info2.as_metrics()
     assert m["revision"] == "f" * 40 and m["version"] == info2.version
     bi.collect.cache_clear()
+
+
+def test_cli_sharded_aggregator_replay(tmp_path):
+    """--aggregator sharded over the virtual 8-device mesh, through the
+    full shell in replay mode with the fast encoder."""
+    from parca_agent_tpu.capture.formats import save_snapshot
+    from parca_agent_tpu.cli import run
+    from parca_agent_tpu.pprof.builder import parse_pprof
+
+    snap = _snap(seed=8)
+    snap_path = tmp_path / "w.snap"
+    save_snapshot(snap, str(snap_path))
+    out = tmp_path / "profiles"
+    rc = run(["--capture", "replay", "--replay", str(snap_path),
+              "--local-store-directory", str(out),
+              "--aggregator", "sharded", "--fast-encode",
+              "--http-address", "127.0.0.1:0", "--windows", "1",
+              "--debuginfo-upload-disable", "--node", "n"])
+    assert rc == 0
+    tot = 0
+    for f in out.iterdir():
+        p = parse_pprof(gzip.decompress(f.read_bytes()))
+        tot += sum(v[0] for _, v, _ in p.samples)
+    assert tot == snap.total_samples()
