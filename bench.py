@@ -554,6 +554,10 @@ def _probe_main() -> None:
     also warms the persistent compile cache for the main attempt."""
     import jax
 
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Honor an explicit cpu pin over the ambient sitecustomize's
+        # forced device platform (same contract as _child_main).
+        jax.config.update("jax_platforms", "cpu")
     _progress(f"probe: jax up, backend={jax.default_backend()}")
     x = jax.device_put(np.zeros(8, np.int32))
     y = np.asarray(jax.jit(lambda a: a + 1)(x))

@@ -115,3 +115,22 @@ def test_run_child_reports_hang(monkeypatch):
     assert isinstance(got, str)
     assert "hung >7s" in got
     assert "first window" in got  # last progress line surfaced
+
+
+def test_probe_child_contract():
+    """The device-liveness probe child (PARCA_BENCH_PROBE_CHILD=1) prints
+    the {"probe": "ok"} JSON line the supervisor's gate scans for."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, PARCA_BENCH_PROBE_CHILD="1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                      "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr[-500:]
+    got = bench._scan_json_line(r.stdout)
+    assert got and got.get("probe") == "ok"
