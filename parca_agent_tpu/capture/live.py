@@ -111,6 +111,8 @@ def load_native():
                                            ctypes.c_long]
     lib.pa_sampler_dedup_hits.restype = ctypes.c_uint64
     lib.pa_sampler_dedup_hits.argtypes = [ctypes.c_void_p]
+    lib.pa_sampler_dedup_overflow.restype = ctypes.c_uint64
+    lib.pa_sampler_dedup_overflow.argtypes = [ctypes.c_void_p]
     lib.pa_decode_v1d_count.restype = ctypes.c_long
     lib.pa_decode_v1d_count.argtypes = [u8p, ctypes.c_long, ctypes.c_long]
     lib.pa_decode_v1d.restype = ctypes.c_long
@@ -292,8 +294,16 @@ def columns_to_snapshot(
     void = np.ascontiguousarray(rec).view(
         np.dtype((np.void, rec.shape[1] * 8))).ravel()
     _, first, inverse = np.unique(void, return_index=True, return_inverse=True)
-    counts = np.bincount(
-        inverse, weights=weights, minlength=len(first)).astype(np.int64)
+    if weights is None:
+        # Unweighted bincount accumulates in exact integers already.
+        counts = np.bincount(inverse, minlength=len(first)).astype(np.int64)
+    else:
+        # Accumulate integrally: bincount with float weights sums in
+        # float64 and is only exact below 2^53 per key, which would make
+        # the sampler's "counts are exact either way" invariant rest on
+        # float precision.
+        counts = np.zeros(len(first), np.int64)
+        np.add.at(counts, inverse, weights.astype(np.int64))
     return WindowSnapshot(
         pids=pids[first], tids=tids[first], counts=counts,
         user_len=ulen[first], kernel_len=klen[first], stacks=stacks[first],
@@ -557,7 +567,8 @@ class PerfEventSampler:
         # drain pass is pure churn on the capture path; only the n written
         # bytes are ever read back.
         self._drainbuf = (ctypes.c_uint8 * self._cap)()
-        self._final_counters = (0, 0, 0)  # (lost, truncated, dedup) at close
+        # (lost, truncated, dedup, dd_overflow) snapshotted at close
+        self._final_counters = (0, 0, 0, 0)
         # Optional per-drain tee (FP mode): called on the polling thread
         # with each drain's columnar chunk so a streaming consumer (the
         # window feeder) can ship it to the aggregation device DURING the
@@ -613,6 +624,15 @@ class PerfEventSampler:
         if self._handle:
             return int(self._lib.pa_sampler_dedup_hits(self._handle))
         return self._final_counters[2]
+
+    @property
+    def dedup_overflow(self) -> int:
+        """Records emitted without table registration because the dedup
+        probe chain saturated — distinguishes hash-table overflow from
+        genuine stack uniqueness when the dedup rate drops."""
+        if self._handle:
+            return int(self._lib.pa_sampler_dedup_overflow(self._handle))
+        return self._final_counters[3]
 
     def _drain_passes(self, consume, dedup: bool = False) -> None:
         """Lossless drain: loops while the native side reports records
@@ -708,7 +728,8 @@ class PerfEventSampler:
     def close(self) -> None:
         if self._handle:
             self._final_counters = (self.lost_samples,
-                                    self.truncated_drains, self.dedup_hits)
+                                    self.truncated_drains, self.dedup_hits,
+                                    self.dedup_overflow)
             self._lib.pa_sampler_destroy(self._handle)
             self._handle = None
         if self._tables is not None:

@@ -403,8 +403,13 @@ def run(argv=None) -> int:
                 StreamingWindowFeeder,
             )
 
-            feeder = StreamingWindowFeeder(aggregator, source._maps,
-                                           source._objs)
+            feeder = StreamingWindowFeeder(
+                aggregator, source._maps, source._objs,
+                # Seed the statics-prebuild period so amortization covers
+                # the FIRST window too (the exact window the cold-statics
+                # transient hits); the profiler refreshes it per window.
+                prebuild_period_ns=int(
+                    1e9 / args.profiling_cpu_sampling_frequency))
             source.on_drain = feeder.on_drain
     profiler = CPUProfiler(
         source=source,

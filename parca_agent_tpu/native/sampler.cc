@@ -93,7 +93,9 @@ struct Sampler {
   uint64_t* dd_hash = nullptr;
   long* dd_off = nullptr;
   size_t dd_cap = 0;
+  bool dd_dirty = false;    // previous dedup drain registered entries
   uint64_t dedup_hits = 0;  // records merged instead of re-emitted
+  uint64_t dd_overflow = 0; // probe budget exhausted: emitted unregistered
 };
 
 // FNV-1a over the sample identity (pid, tid, nk, nu, frames).
@@ -423,10 +425,15 @@ long pa_sampler_drain_dedup(Sampler* s, uint8_t* out, long cap) {
   if (s->capture_stack) return -2;
   if (!s->dd_hash) {
     s->dd_cap = 1 << 16;
-    s->dd_hash = new uint64_t[s->dd_cap];
+    s->dd_hash = new uint64_t[s->dd_cap]();  // zeroed: first pass skips memset
     s->dd_off = new long[s->dd_cap];
   }
-  std::memset(s->dd_hash, 0, s->dd_cap * sizeof(uint64_t));
+  // The 512 KB clear only matters if the previous pass registered
+  // entries; idle drains (empty rings) skip it entirely.
+  if (s->dd_dirty) {
+    std::memset(s->dd_hash, 0, s->dd_cap * sizeof(uint64_t));
+    s->dd_dirty = false;
+  }
   const uint64_t dd_mask = s->dd_cap - 1;
 
   long written = 0;
@@ -472,6 +479,13 @@ long pa_sampler_drain_dedup(Sampler* s, uint8_t* out, long cap) {
     if (s->dd_hash[idx] == 0) {  // probe budget not exhausted
       s->dd_hash[idx] = h;
       s->dd_off[idx] = written;
+      s->dd_dirty = true;
+    } else {
+      // Table saturated along this probe chain: the record is emitted
+      // unregistered, so later repeats in this pass emit separately too.
+      // Counts stay exact; only the pre-aggregation envelope degrades.
+      // This counter lets production tell overflow from true uniqueness.
+      s->dd_overflow++;
     }
     written += need;
     return true;
@@ -480,6 +494,10 @@ long pa_sampler_drain_dedup(Sampler* s, uint8_t* out, long cap) {
 }
 
 uint64_t pa_sampler_dedup_hits(Sampler* s) { return s ? s->dedup_hits : 0; }
+
+uint64_t pa_sampler_dedup_overflow(Sampler* s) {
+  return s ? s->dd_overflow : 0;
+}
 
 // v1d decoders: like v1 below but with the 24-byte header carrying the
 // drain-side count.

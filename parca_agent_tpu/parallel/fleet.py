@@ -333,6 +333,15 @@ def fleet_merge_profiles(node_windows, mesh=None, aggregator=None,
     n_asm = assembly_nodes or n_nodes
     if n_asm <= 1:
         return agg.aggregate(merged), merged
+    if hasattr(agg, "close_window"):
+        # A stateful aggregator (the dict family) treats each aggregate()
+        # as a window: feeding it once per pid-partition would advance its
+        # window/rotation/last-seen clocks n_asm times per merged window.
+        raise TypeError(
+            "fleet_merge_profiles with assembly_nodes > 1 requires a "
+            "stateless aggregator (e.g. CPUAggregator); got "
+            f"{type(agg).__name__} with windowed close_window state"
+        )
     profiles = []
     for node in range(n_asm):
         sel = (merged.pids % n_asm) == node

@@ -255,8 +255,16 @@ class WindowEncoder:
             self._extend_prefixes(self._synced, n)
             self._synced = n
             self._order = None
+
+    def _ensure_order(self) -> None:
+        """Rebuild the id-by-pid sort order if stale. Lazy and separate
+        from _sync: encode() is the only consumer, and the per-drain
+        statics prebuild syncs on the polling thread every second — an
+        eager argsort there would pay O(n log n) over the full id space
+        per drain during population growth for nothing."""
         if self._order is None:
-            pids = agg._id_pid[:n].astype(np.int32, copy=False)
+            n = self._synced
+            pids = self._agg._id_pid[:n].astype(np.int32, copy=False)
             self._order = np.argsort(pids, kind="stable").astype(np.int64)
             self._order_pid = pids[self._order]
 
@@ -355,10 +363,71 @@ class WindowEncoder:
             self._static_gen += 1
         return st
 
+    def _build_tails_batch(self, tables, cpu_idx, nano_idx,
+                           period_ns: int) -> list[bytes]:
+        """Vectorized per-pid tail sections (string table + period_type +
+        period): the scalar loop paid ~3 put_varint calls per string —
+        hundreds of thousands of Python calls on a cold 10k-pid build —
+        here every tag, length varint, and payload byte across the whole
+        batch lands in a handful of whole-array passes."""
+        n_pids = len(tables)
+        blobs = [s.encode() for tbl in tables for s in tbl]
+        joined = np.frombuffer(b"".join(blobs), np.uint8)
+        slen = np.fromiter(map(len, blobs), np.int64, len(blobs))
+        l_slen = varint_len(slen.astype(np.uint64))
+        smsg = 1 + l_slen + slen                 # tag + len varint + bytes
+        counts = np.fromiter(map(len, tables), np.int64, n_pids)
+        sbounds = np.zeros(n_pids + 1, np.int64)
+        np.cumsum(counts, out=sbounds[1:])
+        csum = np.zeros(len(blobs) + 1, np.int64)
+        np.cumsum(smsg, out=csum[1:])
+        sec_len = csum[sbounds[1:]] - csum[sbounds[:-1]]
+
+        cpu_v = np.asarray(cpu_idx, np.uint64)
+        nano_v = np.asarray(nano_idx, np.uint64)
+        l_cpu = varint_len(cpu_v)
+        l_nano = varint_len(nano_v)
+        pt_body = (1 + l_cpu + 1 + l_nano).astype(np.int64)
+        l_ptb = varint_len(pt_body.astype(np.uint64))
+        pt_len = 1 + l_ptb + pt_body
+        pconst_b = bytearray()
+        proto.put_tag_varint(pconst_b, P_PERIOD, period_ns)
+        pconst = np.frombuffer(bytes(pconst_b), np.uint8)
+
+        tail_len = sec_len + pt_len + len(pconst)
+        tb = np.zeros(n_pids + 1, np.int64)
+        np.cumsum(tail_len, out=tb[1:])
+        out = np.empty(int(tb[-1]), np.uint8)
+
+        pid_of_str = np.repeat(np.arange(n_pids), counts)
+        sstart = tb[:-1][pid_of_str] + (csum[:-1] - csum[sbounds[:-1]][pid_of_str])
+        out[sstart] = (P_STRING_TABLE << 3) | 2
+        put_varints(out, sstart + 1, slen.astype(np.uint64), l_slen)
+        joff = np.zeros(len(blobs) + 1, np.int64)
+        np.cumsum(slen, out=joff[1:])
+        ragged_gather(joined, joff[:-1], slen,
+                      out=out, out_starts=sstart + 1 + l_slen)
+
+        p = tb[:-1] + sec_len
+        out[p] = (P_PERIOD_TYPE << 3) | 2
+        put_varints(out, p + 1, pt_body.astype(np.uint64), l_ptb)
+        p2 = p + 1 + l_ptb
+        out[p2] = (VT_TYPE << 3)
+        put_varints(out, p2 + 1, cpu_v, l_cpu)
+        p3 = p2 + 1 + l_cpu
+        out[p3] = (VT_UNIT << 3)
+        put_varints(out, p3 + 1, nano_v, l_nano)
+        pp = (p + pt_len)[:, None] + np.arange(len(pconst))[None, :]
+        out[pp] = pconst[None, :]
+
+        mv = out.data
+        return [bytes(mv[int(tb[k]): int(tb[k + 1])])
+                for k in range(n_pids)]
+
     def _build_head_tail_batch(self, items, period_ns: int) -> None:
         """Batch head/tail build: Python only interns the (few) mapping
-        strings and frames the string table per pid; ALL mapping messages
-        across the batch encode in one vectorized pass (the scalar path's
+        strings per pid; ALL mapping messages AND all tail sections across
+        the batch encode in vectorized passes (the scalar path's
         per-message Writer varints dominated the 50k-pid first build)."""
         mid: list[int] = []
         start: list[int] = []
@@ -367,7 +436,9 @@ class WindowEncoder:
         fidx: list[int] = []
         bidx: list[int] = []
         bounds = [0]
-        tails: list[bytes] = []
+        tables: list[list[str]] = []
+        cpu_i: list[int] = []
+        nano_i: list[int] = []
         for _st, reg in items:
             strings = _Strings()
             strings("samples")
@@ -380,14 +451,10 @@ class WindowEncoder:
                 fidx.append(strings(m.path))
                 bidx.append(strings(m.build_id))
             bounds.append(len(mid))
-            pt = proto.Writer().varint(VT_TYPE, strings("cpu")) \
-                .varint(VT_UNIT, strings("nanoseconds"))
-            tail = bytearray()
-            for s_ in strings.table:
-                proto.put_tag_bytes(tail, P_STRING_TABLE, s_.encode())
-            proto.put_tag_bytes(tail, P_PERIOD_TYPE, bytes(pt.buf))
-            proto.put_tag_varint(tail, P_PERIOD, period_ns)
-            tails.append(bytes(tail))
+            cpu_i.append(strings("cpu"))
+            nano_i.append(strings("nanoseconds"))
+            tables.append(strings.table)
+        tails = self._build_tails_batch(tables, cpu_i, nano_i, period_ns)
         if mid:
             buf, offs = _encode_mapping_stream(mid, start, limit, off,
                                                fidx, bidx)
@@ -406,12 +473,55 @@ class WindowEncoder:
             st.n_mappings = len(reg.mappings)
         self._static_gen += 1
 
-    def build_statics(self, period_ns: int) -> int:
-        """Pre-build every known pid's static sections in ONE vectorized
-        location pass and ONE vectorized mapping pass (the per-pid
-        _ensure_static path pays a vectorization fixed cost per pid —
-        ruinous for the 50k-pid first window). Returns the number of pids
-        now cached. Steady-state encodes then touch only changed pids."""
+    def _build_locs_batch(self, dirty) -> None:
+        """One vectorized location pass over a batch of (static, registry,
+        n_locs) triples whose cached location sections are behind."""
+        from itertools import chain
+
+        lens = np.array([n - st.n_locs for st, reg, n in dirty], np.int64)
+        total = int(lens.sum())
+        bounds = np.zeros(len(dirty) + 1, np.int64)
+        np.cumsum(lens, out=bounds[1:])
+        # Flat streams without 10k+ intermediate per-pid arrays: ids are
+        # each pid's 1-based location numbering continued from its cache.
+        first = np.array([st.n_locs + 1 for st, reg, n in dirty], np.uint64)
+        ids = np.repeat(first, lens) + (
+            np.arange(total, dtype=np.uint64)
+            - np.repeat(bounds[:-1], lens).astype(np.uint64))
+        mids = np.fromiter(
+            chain.from_iterable(reg.loc_mapping_id[st.n_locs:]
+                                for st, reg, n in dirty),
+            np.uint64, total)
+        addrs = np.fromiter(
+            chain.from_iterable(reg.loc_normalized[st.n_locs:]
+                                for st, reg, n in dirty),
+            np.uint64, total)
+        buf, offs = _encode_location_stream(ids, mids, addrs)
+        mv = buf.data
+        for k, (st, reg, n) in enumerate(dirty):
+            st.loc_bytes.extend(
+                mv[int(offs[bounds[k]]): int(offs[bounds[k + 1]])])
+            st.n_locs = n
+        self._static_gen += 1
+
+    def build_statics(self, period_ns: int, budget_s: float | None = None,
+                      chunk: int = 8192) -> int:
+        """Pre-build known pids' static sections in vectorized location and
+        mapping/tail passes (the per-pid _ensure_static path pays a
+        vectorization fixed cost per pid — ruinous for the 50k-pid first
+        window). Returns the number of pids now fully cached.
+
+        budget_s bounds one call's wall time: dirty pids are processed in
+        `chunk`-sized vectorized batches and the call returns between
+        batches once the budget is spent, leaving the rest dirty for the
+        next call. This is the amortization hook — the streaming feeder
+        calls it after every drain feed, so by window close the population
+        discovered during the window is already warm and the close-time
+        statics transient is bounded by one budget, not by the whole
+        window's pid population."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         self._sync()
         agg = self._agg
         dirty: list[tuple[_PidStatic, object, int]] = []
@@ -425,28 +535,26 @@ class WindowEncoder:
                 dirty_ht.append((st, reg))
             if st.n_locs < len(reg.loc_address):
                 dirty.append((st, reg, len(reg.loc_address)))
-        if dirty_ht:
-            self._build_head_tail_batch(dirty_ht, period_ns)
-        if dirty:
-            ids = [np.arange(st.n_locs + 1, n + 1, dtype=np.uint64)
-                   for st, reg, n in dirty]
-            mids = [np.asarray(reg.loc_mapping_id[st.n_locs:], np.uint64)
-                    for st, reg, n in dirty]
-            addrs = [np.asarray(reg.loc_normalized[st.n_locs:], np.uint64)
-                     for st, reg, n in dirty]
-            lens = np.array([len(a) for a in ids], np.int64)
-            bounds = np.zeros(len(dirty) + 1, np.int64)
-            np.cumsum(lens, out=bounds[1:])
-            buf, offs = _encode_location_stream(
-                np.concatenate(ids), np.concatenate(mids),
-                np.concatenate(addrs))
-            mv = buf.data
-            for k, (st, reg, n) in enumerate(dirty):
-                st.loc_bytes.extend(
-                    mv[int(offs[bounds[k]]): int(offs[bounds[k + 1]])])
-                st.n_locs = n
-            self._static_gen += 1
-        return len(agg._pids)
+        left: set[int] = set()  # ids of statics still dirty in any pass
+        did_work = False        # every call makes >=1 chunk of progress
+
+        def _spent() -> bool:
+            return (did_work and budget_s is not None
+                    and _time.perf_counter() - t0 > budget_s)
+
+        for k in range(0, len(dirty_ht), chunk):
+            if _spent():
+                left.update(id(st) for st, _ in dirty_ht[k:])
+                break
+            self._build_head_tail_batch(dirty_ht[k: k + chunk], period_ns)
+            did_work = True
+        for k in range(0, len(dirty), chunk):
+            if _spent():
+                left.update(id(st) for st, _, _ in dirty[k:])
+                break
+            self._build_locs_batch(dirty[k: k + chunk])
+            did_work = True
+        return len(agg._pids) - len(left)
 
     # -- encode --------------------------------------------------------------
 
@@ -459,6 +567,12 @@ class WindowEncoder:
         gstarts = np.concatenate(([0], bounds))
         gends = np.concatenate((bounds, [len(idx)]))
         pids = pids_live[gstarts].astype(np.int32)
+        # Batch-build whatever is still dirty before the per-pid walk: the
+        # per-pid _ensure_static path pays a vectorization fixed cost per
+        # pid, ruinous for a cold 50k-pid first window (the production
+        # profiler lands here without ever calling build_statics itself).
+        # After this, _ensure_static is a pure cache hit per pid.
+        self.build_statics(period_ns)
         statics = [self._ensure_static(int(p), period_ns)
                    for p in pids.tolist()]
 
@@ -525,6 +639,7 @@ class WindowEncoder:
 
         t0 = _time.perf_counter()
         self._sync()
+        self._ensure_order()
         n = len(counts)
         if n > self._synced:
             raise ValueError("counts longer than the synced id space")

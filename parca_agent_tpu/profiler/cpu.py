@@ -92,6 +92,19 @@ class CPUProfiler:
         # when the feeder confirms it saw the whole window.
         if streaming_feeder is not None and self._encoder is None:
             raise ValueError("streaming_feeder requires fast_encode")
+        if streaming_feeder is not None \
+                and hasattr(streaming_feeder, "attach_encoder"):
+            # Statics amortization: the feeder prebuilds pprof static
+            # sections (budgeted) after each drain feed, so the close-time
+            # encode's statics transient is bounded even on a cold first
+            # window at large pid populations.
+            streaming_feeder.attach_encoder(self._encoder)
+            # While an abandoned AGGREGATION call (hang watchdog, below)
+            # may still be executing, it can be inside encoder.encode();
+            # gate the feeder's polling-thread touches on it.
+            streaming_feeder.external_blocked = (
+                lambda: self._device_inflight is not None
+                and not self._device_inflight.is_set())
         self._feeder = streaming_feeder
         self._fallback = fallback_aggregator
         self._device_timeout = device_timeout_s

@@ -15,8 +15,13 @@ stall the capture loop):
   * Every feed runs under a daemon-thread watchdog with a SHORT timeout
     (the polling thread is stalled while a feed runs; perf rings are
     smaller than a window, so a long stall wraps them and loses samples).
-    A failure or hang PERMANENTLY disables the feeder — feeding a wedged
-    device would stall the polling thread again next drain.
+    A failure or hang disables the feeder for a capped-exponential number
+    of WINDOWS (2, 4, ... up to 32): mid-window the feeder never retries
+    (a wedged device would stall the polling thread again next drain),
+    but at window boundaries it re-probes, so a transient hiccup — a
+    tunnel blip, a slow compile — costs a few one-shot windows rather
+    than forfeiting streaming for the process lifetime. Re-enable waits
+    for device_blocked() to clear first (see below).
   * An abandoned (timed-out) feed may still be EXECUTING inside the
     aggregator. Until it actually returns, the aggregator must not be
     touched from any other thread: device_blocked() reports this, and
@@ -54,16 +59,50 @@ class StreamingWindowFeeder:
     the feeder to CPUProfiler(streaming_feeder=...)."""
 
     def __init__(self, aggregator, maps_cache, objs_cache,
-                 feed_timeout_s: float = 3.0):
+                 feed_timeout_s: float = 3.0,
+                 reprobe_base_windows: int = 2,
+                 reprobe_max_windows: int = 32,
+                 prebuild_period_ns: int = 0,
+                 prebuild_budget_s: float = 0.25):
         self._agg = aggregator
         self._maps = maps_cache
         self._objs = objs_cache
         self._timeout = feed_timeout_s
         self._fed_total = 0          # mass fed into the open window
         self._inflight: threading.Event | None = None  # abandoned feed
-        self.disabled = False        # permanent (device trouble)
+        self.disabled = False        # not feeding (cooling down)
+        self._cooldown = 0           # windows until re-probe
+        self._backoff_base = max(1, reprobe_base_windows)
+        self._backoff_max = max(self._backoff_base, reprobe_max_windows)
+        self._backoff = self._backoff_base  # next cooldown length
+        # Statics amortization: with an encoder attached, each successful
+        # feed is followed by a BUDGETED WindowEncoder.build_statics pass,
+        # so the pid population discovered during the window has its pprof
+        # static sections built while the window is still open — bounding
+        # the close-time statics transient (a cold 50k-pid first window
+        # otherwise pays the full build inside the close) to one budget.
+        # Pure host numpy, and race-free by construction: the sampler's
+        # poll() invokes the tee synchronously on the profiler thread, and
+        # the profiler's encode runs while that same thread blocks in its
+        # watchdog wait — tee and encode never overlap except when a
+        # timed-out encode is ABANDONED, which external_blocked gates.
+        self._encoder = None
+        self._prebuild_period = prebuild_period_ns
+        self._prebuild_budget = prebuild_budget_s
+        # Optional external gate (the profiler wires its hang-watchdog
+        # state here): while an ABANDONED AGGREGATION call may still be
+        # executing — it can be inside encoder.encode()/window_counts() —
+        # neither the aggregator nor the encoder may be touched from the
+        # polling thread, so on_drain skips entirely (the incomplete fed
+        # mass then makes the window fall back, which is exactly right).
+        self.external_blocked = None
         self.stats = {"drains_fed": 0, "windows_streamed": 0,
-                      "windows_fallback": 0, "last_close_s": 0.0}
+                      "windows_fallback": 0, "reprobes": 0,
+                      "statics_prebuilt": 0, "last_close_s": 0.0}
+
+    def attach_encoder(self, encoder) -> None:
+        """Wire the profiler's WindowEncoder for statics amortization."""
+        self._encoder = encoder
 
     def device_blocked(self) -> bool:
         """True while an abandoned feed may still be executing inside the
@@ -80,6 +119,8 @@ class StreamingWindowFeeder:
     def on_drain(self, cols) -> None:
         if self.disabled:
             return
+        if self.external_blocked is not None and self.external_blocked():
+            return
         import numpy as np
 
         pids, tids, ulen, klen, stacks, counts = cols
@@ -91,15 +132,40 @@ class StreamingWindowFeeder:
                                    table, 0, 0, weights=counts)
         if len(mini) == 0:
             return
+        if self._fed_total == 0 and (getattr(self._agg, "_fed_total", 0)
+                                     or getattr(self._agg, "_pending", None)):
+            # First feed of a new window with residual open-window state:
+            # a one-shot failed partway (its feed dispatched mass and/or
+            # registered host-side pending rows, its close never ran).
+            # Discard it all — device acc via the reset flag, host mirrors
+            # directly — exactly as window_counts guards its own entry
+            # (aggregator/dict.py). Without this the residue would ride
+            # into the streamed close and inflate counts past the
+            # feeder's own fed-mass gate ("_pending" survives an acc
+            # reset: the flag only zeroes the device accumulator).
+            self._agg._fed_total = 0
+            self._agg._pending = []
+            self._agg._needs_reset = True
         if not self._feed_guarded(mini):
-            # Do NOT try again this agent: a wedged device would stall
-            # the capture loop on every subsequent drain.
+            # Do NOT try again this window: a wedged device would stall
+            # the capture loop on every subsequent drain. Re-probe only
+            # at a window boundary, after a capped-exponential cooldown.
             self.disabled = True
-            _log.warn("streaming feed failed; reverting to one-shot "
-                      "window aggregation permanently")
+            self._cooldown = self._backoff
+            self._backoff = min(self._backoff * 2, self._backoff_max)
+            _log.warn("streaming feed failed; one-shot window "
+                      "aggregation for the next windows",
+                      cooldown_windows=self._cooldown)
             return
         self._fed_total += mini.total_samples()
         self.stats["drains_fed"] += 1
+        if self._encoder is not None and self._prebuild_period:
+            try:
+                self._encoder.build_statics(
+                    self._prebuild_period, budget_s=self._prebuild_budget)
+                self.stats["statics_prebuilt"] += 1
+            except Exception as e:  # noqa: BLE001 - never fail the tee
+                _log.warn("statics prebuild failed", error=repr(e))
 
     def _feed_guarded(self, mini: WindowSnapshot) -> bool:
         box: dict = {}
@@ -136,8 +202,25 @@ class StreamingWindowFeeder:
         for the next window."""
         fed = self._fed_total
         self._fed_total = 0
+        if snapshot.period_ns:
+            self._prebuild_period = snapshot.period_ns
         if self.disabled:
             self.stats["windows_fallback"] += 1
+            self._cooldown -= 1
+            # Re-probe here, at the boundary — never mid-window — and
+            # only once any abandoned feed has actually returned (the
+            # aggregator may not be touched before then).
+            if self._cooldown <= 0 and not self.device_blocked():
+                self.disabled = False
+                self.stats["reprobes"] += 1
+                # The device accumulator may hold residual mass from a
+                # one-shot window_counts that failed AFTER its feed
+                # dispatched (close raised -> CPU fallback, _needs_reset
+                # left False). Force a reset so the first streamed feed
+                # starts from a clean accumulator.
+                self._agg._needs_reset = True
+                _log.info("streaming feeder re-enabled; probing next "
+                          "window")
             return None
         if fed != snapshot.total_samples():
             # A drain raced the window boundary or a tee was skipped:
@@ -149,4 +232,5 @@ class StreamingWindowFeeder:
         counts = self._agg.close_window(copy=False)
         self.stats["windows_streamed"] += 1
         self.stats["last_close_s"] = time.perf_counter() - t0
+        self._backoff = self._backoff_base  # healthy again: reset backoff
         return counts

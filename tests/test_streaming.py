@@ -85,9 +85,195 @@ def test_feeder_disables_on_feed_failure():
     feeder.on_drain(_cols(snap, 0, len(snap)))
     assert feeder.disabled
     assert feeder.take_window_if_complete(snap) is None
-    # Disabled forever: further drains are no-ops, no exception escapes.
+    # Disabled for the cooldown: further drains are no-ops, no exception
+    # escapes.
     feeder.on_drain(_cols(snap, 0, 10))
     assert feeder.stats["drains_fed"] == 0
+
+
+def test_feeder_recovers_after_transient_failure():
+    """A transient device hiccup costs a bounded number of one-shot
+    windows, not streaming for the process lifetime: the feeder re-probes
+    at a window boundary after a capped-exponential cooldown."""
+    snap = _snap(seed=8)
+
+    class Flaky(DictAggregator):
+        fail = True
+
+        def feed(self, *a, **kw):
+            if self.fail:
+                raise RuntimeError("transient device hiccup")
+            return super().feed(*a, **kw)
+
+    agg = Flaky(capacity=1 << 11)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs(),
+                                   reprobe_base_windows=2)
+    feeder.on_drain(_cols(snap, 0, len(snap)))
+    assert feeder.disabled
+    # Device heals immediately; the feeder still waits out its cooldown.
+    agg.fail = False
+    assert feeder.take_window_if_complete(snap) is None   # cooldown 2 -> 1
+    feeder.on_drain(_cols(snap, 0, 10))                   # still ignored
+    assert feeder.stats["drains_fed"] == 0
+    assert feeder.take_window_if_complete(snap) is None   # cooldown 1 -> 0
+    assert not feeder.disabled                            # re-enabled
+    # The next window streams end to end again, exactly.
+    for lo in range(0, len(snap), 64):
+        feeder.on_drain(_cols(snap, lo, min(lo + 64, len(snap))))
+    counts = feeder.take_window_if_complete(snap)
+    assert counts is not None
+    assert int(counts.sum()) == snap.total_samples()
+    assert feeder.stats["reprobes"] == 1
+    # A healthy streamed window resets the backoff to its base.
+    assert feeder._backoff == feeder._backoff_base
+
+
+def test_feeder_prebuilds_statics_during_window():
+    """With an encoder attached, each drain feed is followed by a budgeted
+    statics prebuild, so by close the window's pid population is already
+    warm and the close-time encode pays no cold statics transient."""
+    from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+
+    snap = _snap(seed=10)
+    agg = DictAggregator(capacity=1 << 11)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs(),
+                                   prebuild_period_ns=10_000_000)
+    enc = WindowEncoder(agg)
+    feeder.attach_encoder(enc)
+    for lo in range(0, len(snap), 64):
+        feeder.on_drain(_cols(snap, lo, min(lo + 64, len(snap))))
+    assert feeder.stats["statics_prebuilt"] == feeder.stats["drains_fed"]
+    # Every pid the aggregator knows is cached before close.
+    assert set(enc._static) == set(agg._pids)
+    assert all(st.period_ns == 10_000_000 for st in enc._static.values())
+    counts = feeder.take_window_if_complete(snap)
+    assert counts is not None
+    # The close-time encode matches the scalar builder byte-for-byte even
+    # though its statics were prebuilt incrementally mid-window.
+    out = dict(enc.encode(counts, snap.time_ns, snap.window_ns,
+                          snap.period_ns))
+    from parca_agent_tpu.pprof.builder import parse_pprof
+
+    totals = {pid: sum(v[0] for _, v, _ in parse_pprof(b).samples)
+              for pid, b in out.items()}
+    oracle = {p.pid: p.total() for p in CPUAggregator().aggregate(snap)}
+    assert totals == oracle
+
+
+def test_build_statics_budget_is_incremental():
+    """A budgeted build makes bounded progress per call and converges:
+    repeated calls leave nothing dirty, and the result is identical to an
+    unbudgeted build."""
+    from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+
+    snap = _snap(seed=11, n=900, pids=40)
+    agg = DictAggregator(capacity=1 << 12)
+    counts = agg.window_counts(snap)
+    enc = WindowEncoder(agg)
+    # chunk smaller than the pid count forces multiple batches; a zero
+    # budget stops after the guaranteed first chunk of each call.
+    built = enc.build_statics(snap.period_ns, budget_s=0.0, chunk=8)
+    assert built < len(agg._pids)  # partial progress, not all-at-once
+    for _ in range(200):
+        built = enc.build_statics(snap.period_ns, budget_s=0.0, chunk=8)
+        if built == len(agg._pids):
+            break
+    assert built == len(agg._pids)
+    out = dict(enc.encode(counts, snap.time_ns, snap.window_ns,
+                          snap.period_ns))
+    enc2 = WindowEncoder(agg)
+    enc2.build_statics(snap.period_ns)
+    out2 = dict(enc2.encode(counts, snap.time_ns, snap.window_ns,
+                            snap.period_ns))
+    assert out == out2
+
+
+def test_feeder_discards_residual_device_mass():
+    """A one-shot window_counts that failed AFTER its feed dispatched
+    leaves mass in the device accumulator with _needs_reset False; the
+    feeder's close gate must catch the mismatch and fall back rather
+    than emit inflated counts."""
+    snap = _snap(seed=12)
+    agg = DictAggregator(capacity=1 << 11)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs())
+    # Simulate the partial one-shot: feed dispatched, close never ran.
+    # The residue lives in BOTH the device accumulator and the host-side
+    # _pending mirror (which an acc reset alone would not clear).
+    agg._needs_reset = True
+    agg.feed(snap)
+    assert agg._fed_total > 0 or agg._pending
+    # A fully-streamed window on top of the residue closes EXACTLY: the
+    # first feed discards the stale open-window state wholesale.
+    for lo in range(0, len(snap), 64):
+        feeder.on_drain(_cols(snap, lo, min(lo + 64, len(snap))))
+    counts = feeder.take_window_if_complete(snap)
+    assert counts is not None
+    assert int(counts.sum()) == snap.total_samples()  # not inflated
+
+
+def test_feeder_reenable_resets_accumulator():
+    """Re-enabling after cooldown forces a device-accumulator reset so the
+    first streamed window never builds on residual mass."""
+    snap = _snap(seed=13, n=100, pids=3)
+
+    class Once(DictAggregator):
+        fail = True
+
+        def feed(self, *a, **kw):
+            if self.fail:
+                raise RuntimeError("hiccup")
+            return super().feed(*a, **kw)
+
+    agg = Once(capacity=1 << 10)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs(),
+                                   reprobe_base_windows=1)
+    feeder.on_drain(_cols(snap, 0, len(snap)))
+    assert feeder.disabled
+    agg.fail = False
+    # Mid-cooldown, a one-shot partially fails leaving device mass.
+    agg._needs_reset = True
+    agg.feed(snap)
+    assert agg._fed_total > 0
+    assert feeder.take_window_if_complete(snap) is None  # re-enables
+    assert not feeder.disabled
+    assert agg._needs_reset  # forced clean start for the next feed
+
+
+def test_feeder_skips_while_externally_blocked():
+    """While the profiler's hang watchdog reports an abandoned aggregation
+    call possibly still executing, the polling thread must not touch the
+    aggregator or encoder at all."""
+    snap = _snap(seed=14, n=100, pids=3)
+    agg = DictAggregator(capacity=1 << 10)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs())
+    feeder.external_blocked = lambda: True
+    feeder.on_drain(_cols(snap, 0, len(snap)))
+    assert feeder.stats["drains_fed"] == 0
+    assert not feeder.disabled  # a skip is not a failure
+    feeder.external_blocked = lambda: False
+    feeder.on_drain(_cols(snap, 0, len(snap)))
+    assert feeder.stats["drains_fed"] == 1
+
+
+def test_feeder_backoff_doubles_and_caps():
+    snap = _snap(seed=9, n=50, pids=2)
+
+    class Boom(DictAggregator):
+        def feed(self, *a, **kw):
+            raise RuntimeError("device gone")
+
+    agg = Boom(capacity=1 << 10)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs(),
+                                   reprobe_base_windows=2,
+                                   reprobe_max_windows=8)
+    observed = []
+    for _ in range(4):  # repeated failures: 2, 4, 8, 8 (capped)
+        feeder.on_drain(_cols(snap, 0, len(snap)))
+        assert feeder.disabled
+        observed.append(feeder._cooldown)
+        while feeder.disabled:
+            feeder.take_window_if_complete(snap)
+    assert observed == [2, 4, 8, 8]
 
 
 def test_feeder_hang_is_bounded():
