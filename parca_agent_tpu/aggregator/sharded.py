@@ -9,9 +9,14 @@ partition, pkg/profiler/cpu/maps.go:40-43, applied to the hot table):
     sub-table of capacity/n_shards slots, and the open-addressing probe
     (h1-based linear chain) runs entirely within the home sub-table. The
     device table is [n_shards, cap_s, 4] sharded over axis 0 of the mesh.
-  * The packed feed buffer is replicated to all shards; each shard masks
-    to its own keys (cnt forced to 0 elsewhere) and probes only its
-    sub-table — the probe work and table memory split N ways.
+  * The packed feed buffer is PARTITIONED host-side by home shard (the
+    home hash h2 % n_shards is already computed for every row): shard d
+    receives only its own rows, padded to a shared quarter-pow2 lane
+    count sized to the max per-shard row count (~total/N for a uniform
+    hash), plus each row's original packed-buffer position so miss
+    reports need no reverse mapping. Probe work, H2D bytes, and table
+    memory all split N ways — an earlier design replicated the buffer
+    and masked, which split memory but MULTIPLIED probe FLOPs by N.
   * The accumulator is PARTIAL per shard ([n_shards, id_cap], sharded):
     shard d accumulates only its keys' counts under the global dense stack
     ids. Window close is ONE collective: psum over the shard axis, then
@@ -40,20 +45,21 @@ from parca_agent_tpu.parallel.mesh import FLEET_AXIS, fleet_mesh
 
 @functools.lru_cache(maxsize=8)
 def _sharded_feed_program(mesh, n_shards: int, cap_s: int, id_cap: int,
-                          n_pad: int):
+                          n_pad_s: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     def node_fn(table, acc, packed, reset):
-        # table [1, cap_s, 4]; acc [1, id_cap]; packed replicated [4, n_pad].
-        my = jax.lax.axis_index(FLEET_AXIS).astype(jnp.uint32)
+        # table [1, cap_s, 4]; acc [1, id_cap]; packed [1, 5, n_pad_s] —
+        # THIS shard's rows only (host-partitioned by home shard), rows
+        # being (h1, h2, h3, count, original packed-buffer position).
         t = table[0]
         a = jnp.where(reset != 0, 0, acc[0])
-        h1, h2, h3 = packed[0], packed[1], packed[2]
-        cnt = packed[3].astype(jnp.int32)
-        mine = (h2 % jnp.uint32(n_shards)) == my
-        live = mine & (cnt > 0)
+        h1, h2, h3 = packed[0, 0], packed[0, 1], packed[0, 2]
+        cnt = packed[0, 3].astype(jnp.int32)
+        orig = packed[0, 4].astype(jnp.int32)
+        live = cnt > 0  # pad lanes carry count 0
         mask = jnp.uint32(cap_s - 1)
 
         def probe(k, state):
@@ -69,7 +75,8 @@ def _sharded_feed_program(mesh, n_shards: int, cap_s: int, id_cap: int,
             return found_id, done | stop
 
         # The probe reads the node-sharded table, so the loop carry is
-        # node-varying; mark the (replicated) initial carry to match.
+        # node-varying; mark the (replicated-literal) initial carry to
+        # match.
         found_id = jax.lax.pcast(jnp.full(h1.shape, -1, jnp.int32),
                                  (FLEET_AXIS,), to="varying")
         done = jax.lax.pcast(jnp.zeros(h1.shape, bool),
@@ -81,9 +88,11 @@ def _sharded_feed_program(mesh, n_shards: int, cap_s: int, id_cap: int,
             jnp.where(live, cnt, 0), mode="drop")
         miss = live & ~hit
         mtgt = jnp.where(miss, jnp.cumsum(miss.astype(jnp.int32)) - 1,
-                         jnp.int32(n_pad))
-        miss_rows = jnp.full((n_pad,), -1, jnp.int32).at[mtgt].set(
-            jnp.arange(h1.shape[0], dtype=jnp.int32), mode="drop")
+                         jnp.int32(n_pad_s))
+        # Report ORIGINAL packed-buffer positions (the host partitioned
+        # the rows, so local lane indices would be meaningless to it).
+        miss_rows = jnp.full((n_pad_s,), -1, jnp.int32).at[mtgt].set(
+            orig, mode="drop")
         n_miss = miss.astype(jnp.int32).sum()
         return a[None], n_miss[None], miss_rows[None]
 
@@ -91,7 +100,7 @@ def _sharded_feed_program(mesh, n_shards: int, cap_s: int, id_cap: int,
         node_fn,
         mesh=mesh,
         in_specs=(P(FLEET_AXIS, None, None), P(FLEET_AXIS, None),
-                  P(None, None), P()),
+                  P(FLEET_AXIS, None, None), P()),
         out_specs=(P(FLEET_AXIS, None), P(FLEET_AXIS), P(FLEET_AXIS, None)),
     )
     return jax.jit(fn, donate_argnums=(1,))
@@ -108,8 +117,12 @@ def _sharded_close_program(mesh, n_shards: int, id_cap: int, n_fetch: int,
 
     def node_fn(acc):
         total = jax.lax.psum(acc[0], FLEET_AXIS)  # [id_cap] on every shard
-        # Pack redundantly on every shard (collective-simple); the host
-        # fetches one shard's copy.
+        # Every shard packs the same psum'd total. This is deliberate,
+        # not waste: under SPMD lockstep all shards run the pack
+        # SIMULTANEOUSLY, so close wall-clock equals one shard packing;
+        # serializing the pack onto one shard would idle the rest for the
+        # same latency while adding a broadcast. The host fetches one
+        # shard's copy (one D2H of the packed buffer, not N).
         return pack(total)[None]
 
     fn = jax.shard_map(node_fn, mesh=mesh, in_specs=(P(FLEET_AXIS, None),),
@@ -222,22 +235,59 @@ class ShardedDictAggregator(DictAggregator):
             jnp.zeros((self._n_shards, self._id_cap), jnp.int32),
             NamedSharding(self._mesh, P(FLEET_AXIS, None)))
 
+    def _partition_packed(self, packed: np.ndarray) -> np.ndarray:
+        """Split the [4, n_pad] packed buffer into [n_shards, 5, n_pad_s]
+        by home shard (h2 % n_shards), appending each row's original
+        position as channel 4. Pad lanes are zero (count 0 = dead)."""
+        cnt = packed[3]
+        live = np.flatnonzero(cnt > 0)
+        shard = (packed[1, live] % np.uint32(self._n_shards)).astype(np.int64)
+        # Stable sort keeps ascending packed order within each shard, so
+        # miss (and therefore id-assignment) order is deterministic.
+        order = np.argsort(shard, kind="stable")
+        rows = live[order]
+        per = np.bincount(shard, minlength=self._n_shards)
+        n_max = max(int(per.max(initial=0)), 1)
+        # Quarter-pow2 padding (16, 20, 24, 28, 32, 40, ...): full pow2
+        # rounding wastes up to 2x probe lanes per shard (a near-uniform
+        # hash puts ~total/N rows on each shard, just past a pow2
+        # boundary), while still bounding distinct compiled shapes to
+        # ~4 per octave of drain size.
+        if n_max <= 16:
+            n_pad_s = 16
+        else:
+            step = 1 << max(2, n_max.bit_length() - 3)
+            n_pad_s = -(-n_max // step) * step
+        out = np.zeros((self._n_shards, 5, n_pad_s), np.uint32)
+        bounds = np.zeros(self._n_shards + 1, np.int64)
+        np.cumsum(per, out=bounds[1:])
+        for s in range(self._n_shards):
+            mine = rows[bounds[s]: bounds[s + 1]]
+            out[s, :4, : len(mine)] = packed[:, mine]
+            out[s, 4, : len(mine)] = mine.astype(np.uint32)
+        return out
+
     def _feed_dispatch(self, packed: np.ndarray, n_pad: int,
                        reset: int) -> np.ndarray:
-        import jax.numpy as jnp
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
 
+        part = self._partition_packed(packed)
         prog = _sharded_feed_program(self._mesh, self._n_shards, self._cap_s,
-                                     self._id_cap, n_pad)
+                                     self._id_cap, part.shape[2])
+        dev_packed = jax.device_put(
+            part, NamedSharding(self._mesh, P(FLEET_AXIS, None, None)))
         acc = self._acc
         self._acc = None  # donated: invalid if the call throws
-        acc, n_miss, miss_rows = prog(self._dev, acc, jnp.asarray(packed),
-                                      jnp.uint32(reset))
+        acc, n_miss, miss_rows = prog(self._dev, acc, dev_packed,
+                                      np.uint32(reset))
         self._acc = acc
         per_shard = np.asarray(n_miss)
         if not per_shard.any():
             return np.empty(0, np.int64)
         # Each row has exactly one home shard, so the per-shard miss lists
-        # are disjoint; concatenate them.
+        # are disjoint; concatenate them (original-position indices).
         rows_all = np.asarray(miss_rows)
         return np.concatenate([
             rows_all[s, : int(k)] for s, k in enumerate(per_shard) if k

@@ -265,6 +265,18 @@ def fleet_merge_profiles(node_windows, mesh=None, aggregator=None,
     if not ws:
         raise ValueError("fleet_merge_profiles needs at least one window")
     n_nodes = len(ws)
+    n_asm = assembly_nodes or n_nodes
+    if n_asm > 1 and aggregator is not None \
+            and hasattr(aggregator, "close_window"):
+        # Fail fast, before the O(rows) merge: a stateful aggregator (the
+        # dict family) treats each aggregate() as a window, so feeding it
+        # once per pid-partition would advance its window/rotation/
+        # last-seen clocks n_asm times per merged window.
+        raise TypeError(
+            "fleet_merge_profiles with assembly_nodes > 1 requires a "
+            "stateless aggregator (e.g. CPUAggregator); got "
+            f"{type(aggregator).__name__} with windowed close_window state"
+        )
     r = max(max(len(w) for w in ws), 1)
     h1s = np.zeros((n_nodes, r), np.uint32)
     h2s = np.zeros((n_nodes, r), np.uint32)
@@ -330,18 +342,8 @@ def fleet_merge_profiles(node_windows, mesh=None, aggregator=None,
         time_ns=min(w.time_ns for w in ws),
     )
     agg = aggregator if aggregator is not None else CPUAggregator()
-    n_asm = assembly_nodes or n_nodes
     if n_asm <= 1:
         return agg.aggregate(merged), merged
-    if hasattr(agg, "close_window"):
-        # A stateful aggregator (the dict family) treats each aggregate()
-        # as a window: feeding it once per pid-partition would advance its
-        # window/rotation/last-seen clocks n_asm times per merged window.
-        raise TypeError(
-            "fleet_merge_profiles with assembly_nodes > 1 requires a "
-            "stateless aggregator (e.g. CPUAggregator); got "
-            f"{type(agg).__name__} with windowed close_window state"
-        )
     profiles = []
     for node in range(n_asm):
         sel = (merged.pids % n_asm) == node

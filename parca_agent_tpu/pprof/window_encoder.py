@@ -505,20 +505,22 @@ class WindowEncoder:
         self._static_gen += 1
 
     def build_statics(self, period_ns: int, budget_s: float | None = None,
-                      chunk: int = 8192) -> int:
+                      chunk: int = 4096, loc_chunk: int = 1 << 18) -> int:
         """Pre-build known pids' static sections in vectorized location and
         mapping/tail passes (the per-pid _ensure_static path pays a
         vectorization fixed cost per pid — ruinous for the 50k-pid first
         window). Returns the number of pids now fully cached.
 
         budget_s bounds one call's wall time: dirty pids are processed in
-        `chunk`-sized vectorized batches and the call returns between
-        batches once the budget is spent, leaving the rest dirty for the
-        next call. This is the amortization hook — the streaming feeder
-        calls it after every drain feed, so by window close the population
+        vectorized batches — at most `chunk` pids AND (for the location
+        pass, whose cost tracks rows not pids) at most `loc_chunk` dirty
+        locations per batch — and the call returns between batches once
+        the budget is spent, leaving the rest dirty for the next call.
+        This is the amortization hook — the streaming feeder calls it
+        after every drain feed, so by window close the population
         discovered during the window is already warm and the close-time
-        statics transient is bounded by one budget, not by the whole
-        window's pid population."""
+        statics transient is bounded by roughly one batch past the
+        budget, not by the whole window's pid population."""
         import time as _time
 
         t0 = _time.perf_counter()
@@ -548,12 +550,22 @@ class WindowEncoder:
                 break
             self._build_head_tail_batch(dirty_ht[k: k + chunk], period_ns)
             did_work = True
-        for k in range(0, len(dirty), chunk):
+        k = 0
+        while k < len(dirty):
             if _spent():
                 left.update(id(st) for st, _, _ in dirty[k:])
                 break
-            self._build_locs_batch(dirty[k: k + chunk])
+            # Batch bounded by dirty-LOCATION count, not pid count: one
+            # pid can carry a deep backlog, and the budget is only
+            # honest if a batch's work is bounded.
+            end, locs = k, 0
+            while end < len(dirty) and end - k < chunk and locs < loc_chunk:
+                st, reg, n = dirty[end]
+                locs += n - st.n_locs
+                end += 1
+            self._build_locs_batch(dirty[k: end])
             did_work = True
+            k = end
         return len(agg._pids) - len(left)
 
     # -- encode --------------------------------------------------------------
