@@ -545,6 +545,33 @@ def _last_resort(err: str, rows: int, pids: int) -> dict:
     }
 
 
+def _finalize_result(result: dict, rows: int, pids: int,
+                     device_alive: bool) -> None:
+    """Stamp the MECHANICAL scoring fields so no ratio from a fallback
+    run can be mistaken for the north-star measurement (the r4 artifact's
+    vs_baseline: 159.71 was an honest CPU-backend number at reduced
+    scale, but a skimmer reading the ratio without the error field would
+    conclude the target was smashed):
+
+      scale:  "full" iff the measured window is at least the NORTH-STAR
+              shape (1M rows x 50k pids, BASELINE.md:23) — pinned to the
+              constants, not the requested env, so a custom small run can
+              never claim it.
+      scored: True iff full scale AND a real device backend AND no error
+              — the only combination that counts toward BASELINE.md:23.
+      tunnel_down: present (True) when the device probe never succeeded,
+              so outage rounds are machine-distinguishable from device
+              rounds that failed in measurement."""
+    del rows, pids  # scoring is pinned to the north star, not the request
+    full = (result.get("rows") or 0) >= (1 << 20) \
+        and (result.get("pids") or 0) >= 50_000
+    on_device = result.get("backend") not in ("cpu", "numpy-only", None)
+    result["scale"] = "full" if full else "reduced"
+    result["scored"] = bool(full and on_device and not result.get("error"))
+    if not device_alive:
+        result["tunnel_down"] = True
+
+
 def _probe_main() -> None:
     """Device-liveness probe child: backend init + one tiny round trip,
     nothing else. Prints one JSON line on success. Exists because a dead
@@ -700,6 +727,7 @@ def main() -> None:
                       "unit": "ms", "vs_baseline": None,
                       "error": (" | ".join(errors)
                                 + f" | last-resort failed: {e2!r}")[:500]}
+    _finalize_result(result, rows, pids, device_alive)
     print(json.dumps(result))
 
 

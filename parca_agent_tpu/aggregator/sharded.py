@@ -153,6 +153,7 @@ class ShardedDictAggregator(DictAggregator):
         if cap_s & (cap_s - 1):
             raise ValueError("per-shard capacity must be a power of two")
         self._cap_s = cap_s
+        self._part_bufs: dict[int, np.ndarray] = {}  # n_pad_s -> buffer
         super().__init__(capacity=capacity, id_cap=id_cap, **kw)
 
     # -- host-mirror placement: probe within the key's home sub-table -------
@@ -258,7 +259,18 @@ class ShardedDictAggregator(DictAggregator):
         else:
             step = 1 << max(2, n_max.bit_length() - 3)
             n_pad_s = -(-n_max // step) * step
-        out = np.zeros((self._n_shards, 5, n_pad_s), np.uint32)
+        # Reuse one buffer per lane count (same rationale as the base
+        # feed's _feed_bufs: a fresh multi-MB zeroed allocation per drain
+        # is pure churn on the host hot path); quarter-pow2 lane sizing
+        # bounds the distinct shapes to ~4 per octave of drain size.
+        out = self._part_bufs.get(n_pad_s)
+        if out is None:
+            if len(self._part_bufs) > 16:
+                self._part_bufs.clear()
+            out = np.zeros((self._n_shards, 5, n_pad_s), np.uint32)
+            self._part_bufs[n_pad_s] = out
+        else:
+            out[:] = 0
         bounds = np.zeros(self._n_shards + 1, np.int64)
         np.cumsum(per, out=bounds[1:])
         for s in range(self._n_shards):

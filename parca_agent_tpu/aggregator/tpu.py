@@ -358,6 +358,14 @@ class TPUAggregator:
 
     name: str = "tpu"
 
+    # Unique-location count beyond which the one-shot kernel is the wrong
+    # tool (the location dedup sort dominates: ~45 s at the adversarial
+    # 26.5 M-location synthetic, docs/perf.md) and the streaming dict
+    # aggregator should be used instead. Advisory only — results stay
+    # exact either way.
+    LOC_WARN_THRESHOLD = 1 << 22
+    _loc_warned: bool = False
+
     def aggregate(self, snapshot: WindowSnapshot) -> list[PidProfile]:
         import jax.numpy as jnp
 
@@ -366,6 +374,17 @@ class TPUAggregator:
             return []
         table = snapshot.mappings
         host_args, dims = pack_window_inputs(snapshot)
+        if dims["l_cap"] > self.LOC_WARN_THRESHOLD and not self._loc_warned:
+            # Once per aggregator: this is a per-window hot path.
+            self._loc_warned = True
+            from parca_agent_tpu.utils.log import get_logger
+
+            get_logger("aggregator.tpu").warn(
+                "window location entropy is in the one-shot kernel's "
+                "adversarial regime; --aggregator dict (the streaming "
+                "dictionary) aggregates such windows orders of magnitude "
+                "faster", unique_location_cap=dims["l_cap"],
+                threshold=self.LOC_WARN_THRESHOLD)
         dev_args = tuple(jnp.asarray(a) for a in host_args)
 
         while True:

@@ -299,14 +299,18 @@ def columns_to_snapshot(
         counts = np.bincount(inverse, minlength=len(first)).astype(np.int64)
     else:
         # Weighted bincount sums in float64 — exact only below 2^53 per
-        # key. Window mass is bounded far under that (the aggregator
-        # raises at 2^31), but assert the invariant instead of assuming
-        # it so "counts are exact either way" never silently rests on
-        # float precision. (np.add.at would be integral but is ~10-30x
-        # slower, and this runs per drain on the capture path.)
-        assert int(weights.sum(dtype=np.int64)) < 2**53
-        counts = np.bincount(
-            inverse, weights=weights, minlength=len(first)).astype(np.int64)
+        # key. Window mass is bounded far under that in practice (the
+        # aggregator raises at 2^31), so take the fast path and fall
+        # back to the integral-but-~10-30x-slower scatter-add on the
+        # pathological mass, keeping "counts are exact either way"
+        # unconditional rather than resting on float precision.
+        if int(weights.sum(dtype=np.int64)) < 2**53:
+            counts = np.bincount(
+                inverse, weights=weights, minlength=len(first)).astype(
+                    np.int64)
+        else:
+            counts = np.zeros(len(first), np.int64)
+            np.add.at(counts, inverse, weights.astype(np.int64))
     return WindowSnapshot(
         pids=pids[first], tids=tids[first], counts=counts,
         user_len=ulen[first], kernel_len=klen[first], stacks=stacks[first],

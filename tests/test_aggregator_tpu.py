@@ -194,3 +194,42 @@ def test_vsyscall_mapping_does_not_normalize_kernel_addr():
     (prof,) = TPUAggregator().aggregate(snap)
     assert int(prof.loc_mapping_id[0]) == 0
     assert int(prof.loc_normalized[0]) == 0xFFFFFFFFFF600ABC
+
+
+def test_one_shot_warns_at_high_location_entropy():
+    """VERDICT r4 weak #7: the one-shot kernel is the adversarial-case
+    loser at high unique-location count; --aggregator tpu now says so at
+    runtime instead of silently burning the window. (A direct handler on
+    the component logger, not caplog: the agent's setup_logging sets
+    propagate=False, so caplog is order-dependent across the suite.)"""
+    import logging
+
+    from parca_agent_tpu.aggregator.tpu import TPUAggregator
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("parca_agent_tpu.aggregator.tpu")
+    h = Capture(level=logging.WARNING)
+    logger.addHandler(h)
+    old_level = logger.level
+    logger.setLevel(logging.WARNING)
+    try:
+        snap = generate(SyntheticSpec(n_pids=4, n_unique_stacks=200,
+                                      n_rows=200, total_samples=800,
+                                      mean_depth=8, seed=2))
+        agg = TPUAggregator()
+        agg.LOC_WARN_THRESHOLD = 16  # force the regime, tiny window
+        profiles = agg.aggregate(snap)
+        assert profiles  # results stay exact; the guard is advisory
+        assert any("adversarial regime" in m for m in records)
+        records.clear()
+        agg.aggregate(snap)  # warned once per aggregator, not per window
+        assert not any("adversarial regime" in m for m in records)
+    finally:
+        logger.removeHandler(h)
+        logger.setLevel(old_level)

@@ -212,3 +212,16 @@ def test_notes_iteration(fixtures):
     ef = ElfFile(fixtures["pie"])
     names = {(n.name, n.type) for n in ef.notes()}
     assert ("GNU", 3) in names  # build id present among the notes
+
+
+def test_build_id_rejects_non_elf_input():
+    """Non-ELF images (e.g. an XCOFF object, which the Linux-only capture
+    layer can never map — docs/parity.md §2.8) fail loudly at the ELF
+    parse boundary rather than producing a bogus id."""
+    import pytest
+
+    from parca_agent_tpu.elf.buildid import build_id
+
+    xcoff_like = b"\x01\xf7" + b"\x00" * 62  # XCOFF64 magic, not ELF
+    with pytest.raises(Exception):
+        build_id(xcoff_like)
