@@ -545,8 +545,7 @@ def _last_resort(err: str, rows: int, pids: int) -> dict:
     }
 
 
-def _finalize_result(result: dict, rows: int, pids: int,
-                     device_alive: bool) -> None:
+def _finalize_result(result: dict, device_alive: bool) -> None:
     """Stamp the MECHANICAL scoring fields so no ratio from a fallback
     run can be mistaken for the north-star measurement (the r4 artifact's
     vs_baseline: 159.71 was an honest CPU-backend number at reduced
@@ -562,7 +561,6 @@ def _finalize_result(result: dict, rows: int, pids: int,
       tunnel_down: present (True) when the device probe never succeeded,
               so outage rounds are machine-distinguishable from device
               rounds that failed in measurement."""
-    del rows, pids  # scoring is pinned to the north star, not the request
     full = (result.get("rows") or 0) >= (1 << 20) \
         and (result.get("pids") or 0) >= 50_000
     on_device = result.get("backend") not in ("cpu", "numpy-only", None)
@@ -727,7 +725,7 @@ def main() -> None:
                       "unit": "ms", "vs_baseline": None,
                       "error": (" | ".join(errors)
                                 + f" | last-resort failed: {e2!r}")[:500]}
-    _finalize_result(result, rows, pids, device_alive)
+    _finalize_result(result, device_alive)
     print(json.dumps(result))
 
 

@@ -265,8 +265,8 @@ class ShardedDictAggregator(DictAggregator):
         # bounds the distinct shapes to ~4 per octave of drain size.
         out = self._part_bufs.get(n_pad_s)
         if out is None:
-            if len(self._part_bufs) > 16:
-                self._part_bufs.clear()
+            if len(self._part_bufs) >= 4:  # bounded like dict._feed_bufs:
+                self._part_bufs.pop(min(self._part_bufs))  # evict smallest
             out = np.zeros((self._n_shards, 5, n_pad_s), np.uint32)
             self._part_bufs[n_pad_s] = out
         else:

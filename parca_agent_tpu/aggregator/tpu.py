@@ -374,17 +374,6 @@ class TPUAggregator:
             return []
         table = snapshot.mappings
         host_args, dims = pack_window_inputs(snapshot)
-        if dims["l_cap"] > self.LOC_WARN_THRESHOLD and not self._loc_warned:
-            # Once per aggregator: this is a per-window hot path.
-            self._loc_warned = True
-            from parca_agent_tpu.utils.log import get_logger
-
-            get_logger("aggregator.tpu").warn(
-                "window location entropy is in the one-shot kernel's "
-                "adversarial regime; --aggregator dict (the streaming "
-                "dictionary) aggregates such windows orders of magnitude "
-                "faster", unique_location_cap=dims["l_cap"],
-                threshold=self.LOC_WARN_THRESHOLD)
         dev_args = tuple(jnp.asarray(a) for a in host_args)
 
         while True:
@@ -394,6 +383,20 @@ class TPUAggregator:
             if int(n_locs) <= dims["l_cap"]:
                 break
             dims["l_cap"] *= 2
+
+        if int(n_locs) > self.LOC_WARN_THRESHOLD and not self._loc_warned:
+            # Keyed on the MEASURED unique-location count (known only
+            # after the kernel ran), once per aggregator: the per-window
+            # hot path must not log every window.
+            self._loc_warned = True
+            from parca_agent_tpu.utils.log import get_logger
+
+            get_logger("aggregator.tpu").warn(
+                "window location entropy is in the one-shot kernel's "
+                "adversarial regime; --aggregator dict (the streaming "
+                "dictionary) aggregates such windows orders of magnitude "
+                "faster", unique_locations=int(n_locs),
+                threshold=self.LOC_WARN_THRESHOLD)
 
         return self._build_profiles(
             snapshot, table,

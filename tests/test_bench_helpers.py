@@ -144,25 +144,25 @@ def test_finalize_result_scoring_fields():
     # The real thing: full scale, device backend, no error.
     r = {"rows": 1 << 20, "pids": 50_000, "backend": "tpu",
          "vs_baseline": 25.0}
-    bench._finalize_result(r, 1 << 20, 50_000, device_alive=True)
+    bench._finalize_result(r, device_alive=True)
     assert r["scored"] is True and r["scale"] == "full"
     assert "tunnel_down" not in r
 
     # CPU fallback at reduced scale after a dead probe: unscored, marked.
     r = {"rows": 1 << 17, "pids": 10_000, "backend": "cpu",
          "vs_baseline": 159.71, "error": "device probe failed"}
-    bench._finalize_result(r, 1 << 20, 50_000, device_alive=False)
+    bench._finalize_result(r, device_alive=False)
     assert r["scored"] is False and r["scale"] == "reduced"
     assert r["tunnel_down"] is True
 
     # Device backend but error field set (e.g. a phase died): unscored.
     r = {"rows": 1 << 20, "pids": 50_000, "backend": "tpu",
          "error": "pprof phase died"}
-    bench._finalize_result(r, 1 << 20, 50_000, device_alive=True)
+    bench._finalize_result(r, device_alive=True)
     assert r["scored"] is False and r["scale"] == "full"
 
     # numpy-only last resort: unscored.
     r = {"rows": 1 << 20, "pids": 50_000, "backend": "numpy-only",
          "error": "x"}
-    bench._finalize_result(r, 1 << 20, 50_000, device_alive=True)
+    bench._finalize_result(r, device_alive=True)
     assert r["scored"] is False

@@ -33,6 +33,29 @@ _LIBC_PATHS = (
     "/usr/lib64/libc.so.6",
 )
 
+# Second/third large-DSO goldens beside libc (VERDICT r4 #4): C++
+# runtime (exception tables galore) and the CPython interpreter DSO.
+_EXTRA_DSOS = {
+    "libstdc++": ("/usr/lib/x86_64-linux-gnu/libstdc++.so.6",
+                  "/usr/lib64/libstdc++.so.6"),
+    "libpython": ("/usr/local/lib/libpython3.12.so.1.0",
+                  "/usr/lib/x86_64-linux-gnu/libpython3.12.so.1.0",
+                  "/usr/lib/x86_64-linux-gnu/libpython3.11.so.1.0"),
+}
+
+# One burn-target source for every live DWARF test: FP-omitted non-inlined
+# recursion whose recovered depth proves the DWARF walk.
+def _burn_src(depth: int = 20) -> str:
+    return """
+__attribute__((noinline)) unsigned spin(unsigned x, int d) {
+  if (d > 0) return spin(x * 1103515245u + 12345u, d - 1);
+  for (int i = 0; i < 1000; i++) x = x * 1103515245u + 12345u;
+  return x;
+}
+int main() { volatile unsigned x = 1; for (;;) x = spin(x, DEPTH); }
+""".replace("DEPTH", str(depth))
+
+
 
 @pytest.fixture(scope="module")
 def libc_bytes():
@@ -106,6 +129,39 @@ def test_libc_table_lookup_semantics(libc_table):
     assert ok > 350  # most probes land inside walkable coverage
 
 
+@pytest.mark.parametrize("dso", sorted(_EXTRA_DSOS))
+def test_large_dso_golden(dso):
+    """libc-class golden on further real DSOs: full-table scale,
+    sortedness, walkable-rule coverage, and the interactive build
+    envelope (the reference proves table building on one vendored libc;
+    real fleets unwind through the C++ runtime and interpreter DSOs just
+    as often)."""
+    for cand in _EXTRA_DSOS[dso]:
+        try:
+            with open(cand, "rb") as f:
+                data = f.read()
+            break
+        except OSError:
+            continue
+    else:
+        pytest.skip(f"no host {dso} found")
+    ef = ElfFile(data)
+    sec = ef.section(".eh_frame")
+    assert sec is not None
+    t0 = time.perf_counter()
+    table = build_compact_table(ef.section_data(sec), sec.addr)
+    build_s = time.perf_counter() - t0
+    assert len(table) > 20_000, (dso, len(table))
+    pcs = table["pc"].astype(np.int64)
+    assert np.all(np.diff(pcs) >= 0)
+    kinds, counts = np.unique(table["cfa_type"], return_counts=True)
+    by_kind = dict(zip(kinds.tolist(), counts.tolist()))
+    covered = sum(by_kind.get(k, 0) for k in
+                  (CFA_TYPE_RSP, CFA_TYPE_RBP, CFA_TYPE_EXPRESSION))
+    assert covered / len(table) > 0.75, (dso, by_kind)
+    assert build_s < 60, f"{dso} table build took {build_s:.1f}s"
+
+
 @pytest.mark.live
 def test_live_dwarf_walk_success_rate():
     """Real DWARF-mode capture against a CPU-burning child: the batched
@@ -134,14 +190,7 @@ def test_live_dwarf_walk_success_rate():
     srcp = f"{tmp}/pbburn.cc"
     binp = f"{tmp}/pbburn"
     with open(srcp, "w") as f:
-        f.write("""
-__attribute__((noinline)) unsigned spin(unsigned x, int d) {
-  if (d > 0) return spin(x * 1103515245u + 12345u, d - 1);
-  for (int i = 0; i < 1000; i++) x = x * 1103515245u + 12345u;
-  return x;
-}
-int main() { volatile unsigned x = 1; for (;;) x = spin(x, 20); }
-""")
+        f.write(_burn_src(20))
     r = subprocess.run([gxx, "-O1", "-fomit-frame-pointer", "-o", binp,
                         srcp], capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
@@ -177,6 +226,108 @@ int main() { volatile unsigned x = 1; for (;;) x = spin(x, 20); }
 
 
 @pytest.mark.live
+def test_live_dwarf_walk_rate_mixed_population(tmp_path):
+    """Walk rate across REAL process classes, not only the purpose-built
+    burn binary (VERDICT r4 weak #5: 1549/1549 on one known binary is
+    narrower than the reference's 97% on a messy ruby workload,
+    hacking.md:8-17). Three classes, each captured live in DWARF mode:
+
+      burn    — FP-omitted C recursion (known stack shapes; the floor
+                case the original test covers)
+      libc    — a C child spending its cycles INSIDE libc (qsort +
+                snprintf), so walks traverse distro-built libc frames
+      python  — the CPython interpreter running pure-Python work, so
+                walks traverse libpython's eval loop
+
+    The per-class ratios printed here are the numbers published in
+    docs/perf.md; each class must clear the reference's bar."""
+    import shutil
+    import subprocess
+    import sys
+
+    from parca_agent_tpu.capture.live import (
+        PerfEventSampler,
+        SamplerUnavailable,
+    )
+
+    gxx = shutil.which("g++") or shutil.which("gcc")
+    if gxx is None:
+        pytest.skip("no C compiler for the burn/libc targets")
+    try:
+        PerfEventSampler(frequency_hz=99, window_s=0.1).close()
+    except SamplerUnavailable as e:
+        pytest.skip(f"perf_event not permitted here: {e}")
+
+    burn_src = tmp_path / "pbburn.cc"
+    burn_src.write_text(_burn_src(20))
+    libc_src = tmp_path / "pblibc.cc"
+    libc_src.write_text("""
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+static int cmp(const void* a, const void* b) {
+  return *(const int*)a - *(const int*)b;
+}
+// Static storage: a stack-resident array bigger than the sampler's
+// stack-dump window would truncate every walk at main's frame and
+// measure the capture window, not libc's unwind info.
+static int v[4096];
+int main() {
+  char buf[256]; unsigned x = 1;
+  for (;;) {
+    for (int i = 0; i < 4096; i++) { x = x*1103515245u+12345u; v[i] = x; }
+    qsort(v, 4096, sizeof(int), cmp);              // libc frames
+    snprintf(buf, sizeof buf, "%d %s %f", v[0], "x", 1.0 * v[1]);
+  }
+}
+""")
+    for src, binn in ((burn_src, "pbburn"), (libc_src, "pblibc")):
+        r = subprocess.run([gxx, "-O1", "-fomit-frame-pointer", "-o",
+                            str(tmp_path / binn), str(src)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+
+    # The interpreter runs through a uniquely-named symlink: comm follows
+    # the exec'd name, so the regex matches ONLY the child — a bare
+    # "python" regex would also match this pytest process, whose
+    # jax/XLA-sized mappings would monopolize the serial table builder.
+    import os
+
+    pylink = tmp_path / "pbpyint"
+    os.symlink(os.path.realpath(sys.executable), pylink)
+    classes = {
+        "burn": ([str(tmp_path / "pbburn")], "pbburn"),
+        "libc": ([str(tmp_path / "pblibc")], "pblibc"),
+        "python": ([str(pylink), "-c",
+                    "s=0\nwhile True:\n s+=sum(range(200))"], "pbpyint"),
+    }
+    results = {}
+    for name, (argv, regex) in classes.items():
+        s = PerfEventSampler(frequency_hz=199, window_s=2.0,
+                             capture_stack=True, dwarf_comm_regex=regex)
+        child = subprocess.Popen(argv)
+        try:
+            for _ in range(10):  # tables build async; walk once ready
+                s.poll()
+                if s.walk_stats.total >= 200:
+                    break
+        finally:
+            child.kill()
+            st = s.walk_stats
+            s.close()
+        assert st.total > 0, f"{name}: no register-carrying samples walked"
+        results[name] = (st.success / st.total, st)
+    for name, (ratio, st) in sorted(results.items()):
+        print(f"dwarf walk [{name}]: {ratio:.4f} ({st.success}/{st.total} "
+              f"trunc={st.truncated} nocov={st.pc_not_covered} "
+              f"unsup={st.unsupported})")
+    # The reference's bar is ~97% on a messy workload; hold every class
+    # to >=90% (environment noise margin, same as the single-class test).
+    for name, (ratio, st) in results.items():
+        assert ratio >= 0.90, (name, ratio, st)
+
+
+@pytest.mark.live
 def test_live_dwarf_cli_end_to_end(tmp_path):
     """The full agent shell in DWARF mode against a live FP-less burner:
     written profiles must carry the recovered deep stacks (the whole
@@ -202,14 +353,7 @@ def test_live_dwarf_cli_end_to_end(tmp_path):
     if gxx is None:
         pytest.skip("no C compiler for the burn target")
     src = tmp_path / "pbburn.cc"
-    src.write_text("""
-__attribute__((noinline)) unsigned spin(unsigned x, int d) {
-  if (d > 0) return spin(x * 1103515245u + 12345u, d - 1);
-  for (int i = 0; i < 1000; i++) x = x * 1103515245u + 12345u;
-  return x;
-}
-int main() { volatile unsigned x = 1; for (;;) x = spin(x, 16); }
-""")
+    src.write_text(_burn_src(16))
     binp = tmp_path / "pbburn"
     r = subprocess.run([gxx, "-O1", "-fomit-frame-pointer", "-o",
                         str(binp), str(src)], capture_output=True, text=True)

@@ -248,13 +248,14 @@ def test_cluster_e2e_when_available():
     import re
     import subprocess
 
+    how = tool if tool is not None else "in-cluster serviceaccount"
     kubectl = shutil.which("kubectl")
     if kubectl is None:
-        pytest.skip(f"{tool} present but kubectl missing")
+        pytest.skip(f"{how} present but kubectl missing")
     alive = subprocess.run([kubectl, "version", "--request-timeout=10s"],
                            capture_output=True, timeout=30)
     if alive.returncode != 0:
-        pytest.skip(f"{tool} present but no reachable cluster: "
+        pytest.skip(f"{how} present but no reachable cluster: "
                     f"{alive.stderr.decode(errors='replace')[:120]}")
     manifest = os.path.join(os.path.dirname(__file__), "..", "deploy",
                             "daemonset.yaml")
@@ -271,7 +272,7 @@ def test_cluster_e2e_when_available():
         if have is None or have.returncode != 0:
             pytest.skip(f"manifest image {image!r} not built locally; "
                         "build it (docker build -t ...) and load it into "
-                        f"the {tool} cluster first")
+                        "the cluster first")
     subprocess.run([kubectl, "apply", "-f", manifest], check=True,
                    timeout=120)
     try:
