@@ -591,6 +591,19 @@ def _probe_main() -> None:
           flush=True)
 
 
+def _snap_main() -> None:
+    """Snapshot pre-generation child: numpy-only, no device backend.
+    Runs CONCURRENTLY with the device probe (r5 lesson: the tunnel was
+    alive when the bench started, generation ran first for ~220 s, and
+    the tunnel died before the probe ever fired — ordering alone cost
+    the scored artifact). Specs arrive as JSON [[rows, pids], ...]."""
+    for rows, pids in json.loads(os.environ["PARCA_BENCH_SNAP_SPECS"]):
+        try:
+            _make_snapshot(int(rows), int(pids))
+        except Exception as e:  # noqa: BLE001 - cache is an optimization
+            _progress(f"snapshot pre-generation failed (non-fatal): {e!r}")
+
+
 def _child_main() -> None:
     """The measurement process: no supervision, just run and print."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
@@ -611,6 +624,9 @@ def _child_main() -> None:
 def main() -> None:
     if os.environ.get("PARCA_BENCH_PROBE_CHILD"):
         _probe_main()
+        return
+    if os.environ.get("PARCA_BENCH_SNAP_CHILD"):
+        _snap_main()
         return
     if os.environ.get("PARCA_BENCH_CHILD"):
         _child_main()
@@ -651,20 +667,34 @@ def main() -> None:
                 os.unlink(os.path.join(tmpdir, name))
     except OSError:
         pass
-    try:
-        if not ambient_cpu:
-            _make_snapshot(rows, pids)
-        if (r_rows, r_pids) != (rows, pids) or ambient_cpu:
-            _make_snapshot(r_rows, r_pids)
-    except Exception as e:  # noqa: BLE001 - children can still generate
-        _progress(f"snapshot pre-generation failed (non-fatal): {e!r}")
+    # Generation runs in a child CONCURRENT with the device probe below:
+    # a cold cache costs ~220 s at full scale, and paying it before the
+    # probe once cost a scored artifact (the tunnel was alive at t=0 and
+    # dead by t=220). The child pins cpu so it can never touch the
+    # tunnel; specs are explicit because that pin would otherwise flip
+    # the child's own ambient_cpu reading.
+    specs = []
+    if not ambient_cpu:
+        specs.append([rows, pids])
+    if (r_rows, r_pids) != (rows, pids) or ambient_cpu:
+        specs.append([r_rows, r_pids])
+    snap_proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=dict(os.environ, PARCA_BENCH_SNAP_CHILD="1",
+                 JAX_PLATFORMS="cpu",
+                 PARCA_BENCH_SNAP_SPECS=json.dumps(specs)),
+        stdout=subprocess.DEVNULL)
 
     # Device-liveness probe before the expensive attempt: a dead tunnel
     # hangs inside backend init, so discovering it must cost far less than
     # the main attempt's 900 s budget (r4: a wedged tunnel burned the full
-    # budget inside `import jax`). ONE probe (its success also warms the
-    # backend path for the main attempt); a fast failure — crash, not hang
-    # — gets one retry after a pause, a hang means wedged and does not.
+    # budget inside `import jax`). The probe retries ONCE even after a
+    # hang: the dev tunnel's observed failure mode is FLAPPING (alive at
+    # 01:00, dead by 01:05, back later), not just wedging, so "hung once"
+    # does not mean "hung forever" — a pause plus one more bounded probe
+    # is cheap insurance against writing off a reviving tunnel. Probe
+    # success also warms the persistent compile cache for the main
+    # attempt.
     probe_timeout = float(os.environ.get("PARCA_BENCH_PROBE_TIMEOUT_S", 420))
     device_alive = ambient_cpu or \
         os.environ.get("PARCA_BENCH_PROBE", "1") == "0"
@@ -680,10 +710,21 @@ def main() -> None:
             errors.append(f"device probe: {got}" if isinstance(got, str)
                           else f"device probe: unexpected {got}")
             _progress(f"device probe {p_try} failed")
-            if time.monotonic() - t0 > probe_timeout / 4:
-                break  # hang: the backend is wedged, a retry would too
             if p_try == 1:
-                time.sleep(60)
+                # Hung probes already consumed their full timeout; pause
+                # only after fast failures so a flap gets time to settle.
+                if time.monotonic() - t0 < probe_timeout / 4:
+                    time.sleep(60)
+
+    # Every measurement child (primary, retry, fallback, last resort)
+    # loads the snapshot cache — ensure the concurrent pre-generation
+    # finished writing it before any of them start.
+    try:
+        snap_proc.wait(timeout=600)
+    except subprocess.TimeoutExpired:
+        snap_proc.kill()
+        snap_proc.wait()
+        _progress("snapshot pre-generation overran (children will generate)")
 
     # Attempt 1 (+ one retry on FAST failure — a hang means the backend
     # is wedged and retrying would double the worst case) on the ambient

@@ -357,13 +357,17 @@ class DictAggregator:
         # mirroring the miss path: a failed feed must not leave partial
         # host-side mass that a recovery close would emit as a window.)
         n_pad = 1 << max(4, (n - 1).bit_length())
-        packed = self._feed_bufs.get(n_pad)
+        # LRU (dict order = recency order via pop/re-insert): an
+        # evict-smallest policy would pin stale large buffers after a
+        # burst while current small sizes churn through one slot.
+        packed = self._feed_bufs.pop(n_pad, None)
         if packed is None:
-            if len(self._feed_bufs) >= 4:  # bounded cache: evict smallest
-                self._feed_bufs.pop(min(self._feed_bufs))
-            packed = self._feed_bufs[n_pad] = np.zeros((4, n_pad), np.uint32)
+            if len(self._feed_bufs) >= 4:  # bounded cache
+                self._feed_bufs.pop(next(iter(self._feed_bufs)))
+            packed = np.zeros((4, n_pad), np.uint32)
         else:
             packed[:, n:] = 0  # stale tail from a previous, larger chunk
+        self._feed_bufs[n_pad] = packed
         packed[0, :n] = h1[lo:hi]
         packed[1, :n] = h2[lo:hi]
         packed[2, :n] = h3[lo:hi]

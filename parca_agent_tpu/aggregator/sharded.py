@@ -263,14 +263,20 @@ class ShardedDictAggregator(DictAggregator):
         # feed's _feed_bufs: a fresh multi-MB zeroed allocation per drain
         # is pure churn on the host hot path); quarter-pow2 lane sizing
         # bounds the distinct shapes to ~4 per octave of drain size.
-        out = self._part_bufs.get(n_pad_s)
+        # LRU, not evict-smallest: quarter-pow2 sizing yields ~4 shapes
+        # per octave (vs pow2's 1), so a size-ordered policy both
+        # thrashes when drains jitter across an octave boundary and pins
+        # large stale buffers forever after a burst. 8 recently-used
+        # slots track the actual working set; re-insertion on hit keeps
+        # dict order = recency order.
+        out = self._part_bufs.pop(n_pad_s, None)
         if out is None:
-            if len(self._part_bufs) >= 4:  # bounded like dict._feed_bufs:
-                self._part_bufs.pop(min(self._part_bufs))  # evict smallest
+            if len(self._part_bufs) >= 8:
+                self._part_bufs.pop(next(iter(self._part_bufs)))  # LRU
             out = np.zeros((self._n_shards, 5, n_pad_s), np.uint32)
-            self._part_bufs[n_pad_s] = out
         else:
             out[:] = 0
+        self._part_bufs[n_pad_s] = out
         bounds = np.zeros(self._n_shards + 1, np.int64)
         np.cumsum(per, out=bounds[1:])
         for s in range(self._n_shards):
