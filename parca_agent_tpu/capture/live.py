@@ -32,7 +32,6 @@ import ctypes
 import os
 import re
 import struct
-import subprocess
 import threading
 import time
 
@@ -50,9 +49,6 @@ from parca_agent_tpu.utils.log import get_logger
 
 _log = get_logger("capture")
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
-_LIB = os.path.join(_NATIVE_DIR, "libpasampler.so")
-
 PA_CAPTURE_USER_STACK = 1
 
 
@@ -61,18 +57,14 @@ class SamplerUnavailable(RuntimeError):
 
 
 def build_native(force: bool = False) -> str:
-    """Compile libpasampler.so if missing or stale; returns its path.
+    """Compile libpasampler.so if missing or stale; returns its path
+    (shared build-on-demand policy: native.ensure_built)."""
+    from parca_agent_tpu.native import ensure_built
 
-    The shared object is never checked in (it is gitignored): a fresh
-    checkout always compiles from the reviewed source."""
-    src = os.path.join(_NATIVE_DIR, "sampler.cc")
-    if force or not os.path.exists(_LIB) or \
-            os.path.getmtime(_LIB) < os.path.getmtime(src):
-        r = subprocess.run(["make", "-C", _NATIVE_DIR, "libpasampler.so"],
-                           capture_output=True, text=True)
-        if r.returncode != 0:
-            raise SamplerUnavailable(f"native build failed:\n{r.stderr}")
-    return _LIB
+    try:
+        return ensure_built("libpasampler.so", "sampler.cc", force=force)
+    except RuntimeError as e:
+        raise SamplerUnavailable(str(e)) from None
 
 
 def load_native():

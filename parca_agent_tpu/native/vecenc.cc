@@ -1,0 +1,71 @@
+// Native varint emission for the pprof window encoder (pprof/vec.py).
+//
+// The numpy byte-plane encoder is whole-array vectorized, but at north-star
+// scale (~25M frame varints per window) its gather/scatter passes go
+// memory-system-superlinear: measured 1.67 s for 25M values vs 0.15 s for
+// 3.1M (11x for 8x) on the dev host. One sequential C pass emits the same
+// stream in ~0.1 s: positions arrive sorted ascending, so the write
+// pattern is a forward walk with tiny holes (the per-id section headers).
+//
+// Same wire contract as proto.put_varint (unsigned LEB128; callers
+// pre-mask negatives to two's-complement uint64). The reference's encoder
+// leans on Go's gzip/proto machinery for this role (pkg/profiler/pprof.go);
+// here the hot loop is native with the numpy path as a build-less fallback.
+
+#include <cstdint>
+
+extern "C" {
+
+// Byte length of each value's unsigned LEB128 varint (1..10), matching
+// vec.varint_len: ceil(bit_length/7), with 0 -> 1 byte.
+void pa_varint_lens(const uint64_t* vals, int64_t n, int32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    int bits = 64 - __builtin_clzll(vals[i] | 1);
+    out[i] = (bits + 6) / 7;
+  }
+}
+
+// Emit vals[i]'s varint at out + pos[i]. Regions are caller-sized
+// (pa_varint_lens / vec.varint_len) and non-overlapping; the minimal
+// LEB128 encoding written here fills each region exactly. Returns -1, or
+// the first index whose region would leave [0, out_len) — checked before
+// writing (the numpy path raises IndexError on a bad caller; silent heap
+// corruption here would be strictly worse).
+int64_t pa_put_varints(uint8_t* out, int64_t out_len, const int64_t* pos,
+                       const uint64_t* vals, int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t v = vals[i];
+    int bits = 64 - __builtin_clzll(v | 1);
+    int64_t len = (bits + 6) / 7;
+    if (pos[i] < 0 || pos[i] + len > out_len) return i;
+    uint8_t* p = out + pos[i];
+    while (v >= 0x80) {
+      *p++ = static_cast<uint8_t>(v) | 0x80;
+      v >>= 7;
+    }
+    *p = static_cast<uint8_t>(v);
+  }
+  return -1;
+}
+
+// Fixed-width (non-minimal) varints for the template patch path
+// (vec.put_varints_padded): continuation bit on all but the last of
+// `width` bytes. Caller guarantees width >= varint_len(max value).
+int64_t pa_put_varints_padded(uint8_t* out, int64_t out_len,
+                              const int64_t* pos, const uint64_t* vals,
+                              int64_t n, int32_t width) {
+  if (width < 1 && n > 0) return 0;  // final byte write is unconditional
+  for (int64_t i = 0; i < n; i++) {
+    if (pos[i] < 0 || pos[i] + width > out_len) return i;
+    uint8_t* p = out + pos[i];
+    uint64_t v = vals[i];
+    for (int32_t k = 0; k < width - 1; k++) {
+      *p++ = static_cast<uint8_t>(v & 0x7F) | 0x80;
+      v >>= 7;
+    }
+    *p = static_cast<uint8_t>(v & 0x7F);
+  }
+  return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,109 @@
+"""Native varint kernel (native/vecenc.cc) vs the numpy fallback.
+
+vec.py dispatches to the native emission kernel when it builds/loads, and
+keeps the numpy byte-plane path as the build-less fallback — these tests
+pin byte-identical output between the two and the bounds-check contract
+(a bad caller must get IndexError from either path, never a silent
+out-of-bounds write; the reference leans on Go's memory safety for the
+equivalent encode path, pkg/profiler/pprof.go).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.pprof import vec
+
+
+@pytest.fixture()
+def native_lib():
+    lib = vec._load_native()
+    if lib is None:
+        pytest.skip("native vecenc unavailable (no toolchain?)")
+    return lib
+
+
+def _numpy_only(monkeypatch):
+    monkeypatch.setattr(vec, "_native", None)
+
+
+@pytest.mark.parametrize("maxv", [2, 128, 4000, 1 << 40, None])
+def test_native_matches_numpy(native_lib, monkeypatch, maxv):
+    rng = np.random.default_rng(3)
+    hi = np.iinfo(np.uint64).max if maxv is None else maxv
+    vals = rng.integers(0, hi, 4096, dtype=np.uint64)
+
+    lens_nat = vec.varint_len(vals)
+    pos = np.zeros(len(vals), np.int64)
+    np.cumsum(lens_nat[:-1], out=pos[1:])
+    total = int(pos[-1] + lens_nat[-1])
+
+    out_nat = np.zeros(total, np.uint8)
+    vec.put_varints(out_nat, pos, vals, lens_nat)
+    pad_nat = np.zeros(len(vals) * 10, np.uint8)
+    vec.put_varints_padded(pad_nat, np.arange(len(vals), dtype=np.int64) * 10,
+                           vals, 10)
+
+    _numpy_only(monkeypatch)
+    lens_np = vec.varint_len(vals)
+    out_np = np.zeros(total, np.uint8)
+    vec.put_varints(out_np, pos, vals, lens_np)
+    pad_np = np.zeros(len(vals) * 10, np.uint8)
+    vec.put_varints_padded(pad_np, np.arange(len(vals), dtype=np.int64) * 10,
+                           vals, 10)
+
+    np.testing.assert_array_equal(lens_nat, lens_np)
+    np.testing.assert_array_equal(out_nat, out_np)
+    np.testing.assert_array_equal(pad_nat, pad_np)
+
+
+def test_bounds_check_raises_both_paths(native_lib, monkeypatch):
+    """A region leaving the buffer raises IndexError — native checks
+    before writing; numpy's fancy indexing raises on its own."""
+    vals = np.array([1, 300], np.uint64)   # lens 1, 2
+    pos = np.array([0, 2], np.int64)       # needs 4 bytes; give 3
+    out = np.zeros(3, np.uint8)
+    with pytest.raises(IndexError):
+        vec.put_varints(out, pos, vals)
+    with pytest.raises(IndexError):
+        vec.put_varints_padded(out, np.array([0], np.int64),
+                               np.array([7], np.uint64), 5)
+    _numpy_only(monkeypatch)
+    with pytest.raises(IndexError):
+        vec.put_varints(out, pos, vals)
+    with pytest.raises(IndexError):
+        vec.put_varints_padded(out, np.array([0], np.int64),
+                               np.array([7], np.uint64), 5)
+
+
+def test_negative_position_rejected_both_paths(native_lib, monkeypatch):
+    """Numpy fancy indexing would WRAP a negative position to the end of
+    the buffer (silent corruption); both paths must reject instead."""
+    out = np.zeros(8, np.uint8)
+    neg = np.array([-1], np.int64)
+    five = np.array([5], np.uint64)
+    with pytest.raises(IndexError):
+        vec.put_varints(out, neg, five)
+    with pytest.raises(IndexError):
+        vec.put_varints_padded(out, neg, five, 3)
+    _numpy_only(monkeypatch)
+    with pytest.raises(IndexError):
+        vec.put_varints(out, neg, five)
+    with pytest.raises(IndexError):
+        vec.put_varints_padded(out, neg, five, 3)
+    assert not out.any()  # nothing was written by any rejected call
+
+
+def test_readonly_output_rejected_not_corrupted(native_lib):
+    """A read-only buffer must not be written through the raw pointer:
+    the native gate falls through to numpy, which raises."""
+    out = np.zeros(8, np.uint8)
+    out.flags.writeable = False
+    with pytest.raises((ValueError, IndexError)):
+        vec.put_varints(out, np.array([0], np.int64),
+                        np.array([5], np.uint64))
+    with pytest.raises((ValueError, IndexError)):
+        vec.put_varints_padded(out, np.array([0], np.int64),
+                               np.array([5], np.uint64), 3)
+    assert not out.any()
