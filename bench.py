@@ -196,16 +196,21 @@ def _make_snapshot(rows: int, pids: int):
 def run(emit=None) -> dict:
     """The measurement. ``emit``, when set, is called with the headline
     result dict as soon as the core numbers exist — the instant the
-    steady-state closes and the (already-measured) CPU baseline give a
-    real vs_baseline, BEFORE the pprof/sync/extra phases run. The r3
-    device attempt produced a passing close number and then hung in a
-    later phase, so the JSON line was never printed and the attempt
-    scored as a failure; the supervisor scans whatever stdout a hung
-    child captured, so the early flushed line makes every later phase
-    unable to lose the headline. To the same end the CPU baseline
-    (numpy-only) runs FIRST, before any device compile, and the
+    steady-state closes and the CPU baseline give a real vs_baseline,
+    BEFORE the pprof/sync/extra phases run. The r3 device attempt
+    produced a passing close number and then hung in a later phase, so
+    the JSON line was never printed and the attempt scored as a failure;
+    the supervisor scans whatever stdout a hung child captured, so the
+    early flushed line makes every later phase unable to lose the
+    headline. Phase ORDER is dictated by the dev tunnel's observed
+    failure mode — it flaps on a minutes scale (r5: probe alive at
+    t+7 s, dead before the child's first device op at t+270 s) — so the
+    DEVICE is touched first: tunnel RTT within seconds of backend-up,
+    then the feed-path compile, then the steady-state closes. The CPU
+    baseline (numpy-only, cannot hang on the tunnel) runs AFTER the
+    device phases; it is only needed at headline-emit time. The
     population insert rides the feed path so only the feed+close
-    programs compile before the headline exists (window_counts now rides
+    programs compile before the headline exists (window_counts rides
     the same programs, so the sync phase adds no compile at all)."""
     extras: dict = {}
     rows = int(os.environ.get("PARCA_BENCH_ROWS", 1 << 20))
@@ -235,29 +240,10 @@ def run(emit=None) -> dict:
 
     _progress(f"jax up, backend={jax.default_backend()}")
 
-    from parca_agent_tpu.aggregator.cpu import window_counts_rebuild
-    from parca_agent_tpu.aggregator.dict import DictAggregator
-
-    snap = _make_snapshot(rows, pids)
-    total = snap.total_samples()
-    rep_idle_s = float(os.environ.get("PARCA_BENCH_REP_IDLE_S", 1.0))
-
-    _progress(f"snapshot ready: {rows} rows, {pids} pids")
-    # CPU baseline FIRST: numpy-only, so the headline's vs_baseline exists
-    # before the device backend has compiled (or hung) anything.
-    cpu_times = []
-    for _ in range(cpu_reps):
-        if rep_idle_s:  # same duty cycle as the TPU reps (fair baseline)
-            time.sleep(rep_idle_s)
-        t0 = time.perf_counter()
-        cpu_counts = window_counts_rebuild(snap)
-        cpu_times.append(time.perf_counter() - t0)
-    cpu_ms = _median_ms(cpu_times)
-    assert int(cpu_counts.sum()) == total
-    del cpu_counts
-
-    _progress(f"cpu rebuild done: {cpu_ms:.1f} ms")
-    # Measure the tunnel's fixed round-trip (tiny compute + tiny fetch).
+    # Touch the device IMMEDIATELY: the tunnel's aliveness windows are
+    # minutes long, so every host-side second spent before the first
+    # device op is tunnel lifetime thrown away. This also measures the
+    # tunnel's fixed round-trip (tiny compute + tiny fetch).
     tiny = jax.jit(lambda a: a + 1)
     x = jax.device_put(np.zeros(8, np.int32))
     np.asarray(tiny(x))
@@ -267,10 +253,18 @@ def run(emit=None) -> dict:
         np.asarray(tiny(x))
         rtts.append(time.perf_counter() - t0)
     tunnel_rtt_ms = _median_ms(rtts)
+    _progress(f"tunnel rtt {tunnel_rtt_ms:.1f} ms")
 
+    from parca_agent_tpu.aggregator.cpu import window_counts_rebuild
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+
+    snap = _make_snapshot(rows, pids)
+    total = snap.total_samples()
+    rep_idle_s = float(os.environ.get("PARCA_BENCH_REP_IDLE_S", 1.0))
+
+    _progress(f"snapshot ready: {rows} rows, {pids} pids")
     # Table sized 4x the expected population: load factor ~0.25 keeps probe
     # chains within the device bound, id headroom 2x.
-    _progress(f"tunnel rtt {tunnel_rtt_ms:.1f} ms")
     cap = 1 << max(16, (4 * rows - 1).bit_length())
     agg = DictAggregator(capacity=cap, id_cap=cap // 2)
     hashes = agg.hash_rows(snap)
@@ -332,6 +326,22 @@ def run(emit=None) -> dict:
     phases = {k: round(_median_ms(v), 2) for k, v in phase_samples.items()}
 
     _progress(f"steady-state done: close median {tpu_ms:.1f} ms")
+    # CPU baseline AFTER the device phases (see docstring: the tunnel
+    # flaps, numpy can't hang, and the headline needs both numbers —
+    # deferring this loses nothing while saving ~90 s of pre-device
+    # tunnel exposure at full scale).
+    cpu_times = []
+    for _ in range(cpu_reps):
+        if rep_idle_s:  # same duty cycle as the TPU reps (fair baseline)
+            time.sleep(rep_idle_s)
+        t0 = time.perf_counter()
+        cpu_counts = window_counts_rebuild(snap)
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_ms = _median_ms(cpu_times)
+    assert int(cpu_counts.sum()) == total
+    del cpu_counts
+
+    _progress(f"cpu rebuild done: {cpu_ms:.1f} ms")
     result = {
         "metric": "steady_window_ms",
         "value": round(tpu_ms, 3),

@@ -55,8 +55,17 @@ def _load_native() -> ctypes.CDLL | None:
                     ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
                     ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
                 _native = lib
-            except Exception:  # noqa: BLE001 - fallback is the numpy path
+            except Exception as e:  # noqa: BLE001 - fallback is numpy
                 _native = None
+                # One warning, not silence: the numpy byte-plane path is
+                # ~1.7x slower per window at north-star scale
+                # (docs/perf.md), and a host missing g++ would otherwise
+                # regress invisibly.
+                from parca_agent_tpu.utils.log import get_logger
+
+                get_logger(__name__).warning(
+                    "native varint kernel unavailable (%s: %s); "
+                    "falling back to the numpy encode path", type(e).__name__, e)
     return _native
 
 
@@ -80,6 +89,30 @@ def varint_len(vals: np.ndarray) -> np.ndarray:
     return lens
 
 
+def _dispatch_native(fn, out: np.ndarray, pos: np.ndarray,
+                     vals: np.ndarray, *extra) -> bool:
+    """Shared gate for the native scatter kernels. The C loops trust
+    len(pos) == len(vals) and index `out` only after a bounds check, so
+    the length agreement MUST be validated here: the numpy fallback
+    raises IndexError on a short `pos` via fancy indexing, and the native
+    path reading past `pos` could fabricate an in-bounds position and
+    corrupt `out` silently (vecenc.cc: 'silent heap corruption here would
+    be strictly worse'). Returns True when the native kernel ran."""
+    if len(pos) != len(vals):
+        raise IndexError(
+            f"pos has {len(pos)} entries for {len(vals)} values")
+    if fn is None or not (out.flags.c_contiguous and out.flags.writeable
+                          and out.dtype == np.uint8):
+        return False
+    bad = fn(out.ctypes.data, len(out), pos.ctypes.data, vals.ctypes.data,
+             len(vals), *extra)
+    if bad >= 0:
+        raise IndexError(
+            f"varint region for value {bad} (pos {int(pos[bad])}) "
+            f"leaves the {len(out)}-byte buffer")
+    return True
+
+
 def put_varints(out: np.ndarray, pos: np.ndarray, vals: np.ndarray,
                 lens: np.ndarray | None = None) -> None:
     """Scatter varint encodings of vals into uint8 buffer `out` at byte
@@ -90,17 +123,10 @@ def put_varints(out: np.ndarray, pos: np.ndarray, vals: np.ndarray,
     of every encoding is written in one vectorized pass.
     """
     vals = np.ascontiguousarray(vals, np.uint64)
+    pos = np.ascontiguousarray(pos, np.int64)
     lib = _load_native()
-    if lib is not None and out.flags.c_contiguous \
-            and out.flags.writeable and out.dtype == np.uint8:
-        pos = np.ascontiguousarray(pos, np.int64)
-        bad = lib.pa_put_varints(out.ctypes.data, len(out),
-                                 pos.ctypes.data, vals.ctypes.data,
-                                 len(vals))
-        if bad >= 0:
-            raise IndexError(
-                f"varint region for value {bad} (pos {int(pos[bad])}) "
-                f"leaves the {len(out)}-byte buffer")
+    if _dispatch_native(lib.pa_put_varints if lib is not None else None,
+                        out, pos, vals):
         return
     if lens is None:
         lens = varint_len(vals)
@@ -127,18 +153,18 @@ def put_varints_padded(out: np.ndarray, pos: np.ndarray, vals: np.ndarray,
     counts into a cached template instead of re-serializing. Caller must
     pick width >= varint_len(max value) (5 covers uint32, 10 covers any
     uint64)."""
+    # Both paths reject a bad width identically (the native kernel's own
+    # width<1 check would surface as a misleading bounds IndexError, and
+    # the numpy loop would silently write nothing); >10 would emit
+    # continuation bytes beyond the longest legal protobuf varint.
+    if not 1 <= width <= 10:
+        raise ValueError(f"padded varint width must be in 1..10, got {width}")
     vals = np.ascontiguousarray(vals, np.uint64)
     pos = np.ascontiguousarray(pos, np.int64)
     lib = _load_native()
-    if lib is not None and out.flags.c_contiguous \
-            and out.flags.writeable and out.dtype == np.uint8:
-        bad = lib.pa_put_varints_padded(out.ctypes.data, len(out),
-                                        pos.ctypes.data, vals.ctypes.data,
-                                        len(vals), width)
-        if bad >= 0:
-            raise IndexError(
-                f"varint region for value {bad} (pos {int(pos[bad])}) "
-                f"leaves the {len(out)}-byte buffer")
+    if _dispatch_native(
+            lib.pa_put_varints_padded if lib is not None else None,
+            out, pos, vals, width):
         return
     if len(pos) and int(np.min(pos)) < 0:
         raise IndexError("negative varint position")  # wrap = corruption

@@ -107,3 +107,40 @@ def test_readonly_output_rejected_not_corrupted(native_lib):
         vec.put_varints_padded(out, np.array([0], np.int64),
                                np.array([5], np.uint64), 3)
     assert not out.any()
+
+
+def test_length_mismatch_rejected_both_paths(native_lib, monkeypatch):
+    """pos/vals length disagreement raises IndexError from BOTH paths: the
+    native loop would otherwise read past `pos` and could fabricate an
+    in-bounds position — a silent write at an arbitrary offset."""
+    out = np.zeros(64, np.uint8)
+    short_pos = np.array([0, 2], np.int64)
+    vals = np.array([1, 2, 3], np.uint64)
+    with pytest.raises(IndexError):
+        vec.put_varints(out, short_pos, vals)
+    with pytest.raises(IndexError):
+        vec.put_varints_padded(out, short_pos, vals, 5)
+    _numpy_only(monkeypatch)
+    with pytest.raises(IndexError):
+        vec.put_varints(out, short_pos, vals)
+    with pytest.raises(IndexError):
+        vec.put_varints_padded(out, short_pos, vals, 5)
+    assert not out.any()
+
+
+def test_padded_width_out_of_range_rejected_both_paths(native_lib,
+                                                       monkeypatch):
+    """width<1 (would write nothing / trip the kernel's bounds return) and
+    width>10 (longer than any legal protobuf varint) raise ValueError
+    identically on both paths, before anything is written."""
+    out = np.zeros(64, np.uint8)
+    pos = np.array([0], np.int64)
+    vals = np.array([7], np.uint64)
+    for width in (0, -1, 11):
+        with pytest.raises(ValueError):
+            vec.put_varints_padded(out, pos, vals, width)
+    _numpy_only(monkeypatch)
+    for width in (0, -1, 11):
+        with pytest.raises(ValueError):
+            vec.put_varints_padded(out, pos, vals, width)
+    assert not out.any()
