@@ -63,9 +63,9 @@ def _load_native() -> ctypes.CDLL | None:
                 # regress invisibly.
                 from parca_agent_tpu.utils.log import get_logger
 
-                get_logger(__name__).warning(
-                    "native varint kernel unavailable (%s: %s); "
-                    "falling back to the numpy encode path", type(e).__name__, e)
+                get_logger("pprof.vec").warn(
+                    "native varint kernel unavailable; falling back to "
+                    "the numpy encode path", error=repr(e))
     return _native
 
 

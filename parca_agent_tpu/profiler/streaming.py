@@ -60,6 +60,7 @@ class StreamingWindowFeeder:
 
     def __init__(self, aggregator, maps_cache, objs_cache,
                  feed_timeout_s: float = 3.0,
+                 first_feed_timeout_s: float = 60.0,
                  reprobe_base_windows: int = 2,
                  reprobe_max_windows: int = 32,
                  prebuild_period_ns: int = 0,
@@ -68,6 +69,19 @@ class StreamingWindowFeeder:
         self._maps = maps_cache
         self._objs = objs_cache
         self._timeout = feed_timeout_s
+        # The very FIRST feed attempt of the process gets the longer
+        # budget: it includes the XLA compile of the feed program (tens
+        # of seconds on a TPU backend, more through a tunnel), so a
+        # compile-blind short timeout would trip on EVERY cold start and
+        # streaming could never engage at all. The long budget applies
+        # exactly once — if that attempt times out (device wedged from
+        # boot), every later re-probe runs under the SHORT timeout, so a
+        # dead device costs one long capture-loop stall, not one per
+        # cooldown. A timed-out-but-healthy first feed keeps compiling
+        # in its abandoned daemon thread, so a later 3 s re-probe still
+        # lands on the warm program cache and succeeds.
+        self._first_timeout = max(feed_timeout_s, first_feed_timeout_s)
+        self._first_attempted = False
         self._fed_total = 0          # mass fed into the open window
         self._inflight: threading.Event | None = None  # abandoned feed
         self.disabled = False        # not feeding (cooling down)
@@ -182,11 +196,14 @@ class StreamingWindowFeeder:
 
         threading.Thread(target=call, name="stream-feed",
                          daemon=True).start()
-        if not done.wait(self._timeout):
+        timeout = self._first_timeout if not self._first_attempted \
+            else self._timeout
+        self._first_attempted = True
+        if not done.wait(timeout):
             # Abandoned: the call may still be mutating the aggregator.
             self._inflight = done
             _log.error("streaming feed hung; abandoning",
-                       timeout_s=self._timeout)
+                       timeout_s=timeout)
             return False
         if "err" in box:
             _log.warn("streaming feed error", error=repr(box["err"]))

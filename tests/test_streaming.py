@@ -287,8 +287,12 @@ def test_feeder_hang_is_bounded():
             release.wait(20)
 
     agg = Wedge(capacity=1 << 10)
+    # first_feed_timeout_s pinned down too: the cold-start budget is
+    # deliberately long in production (it covers the XLA compile), and
+    # this test wedges the very first feed.
     feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs(),
-                                   feed_timeout_s=0.2)
+                                   feed_timeout_s=0.2,
+                                   first_feed_timeout_s=0.2)
     import time
 
     t0 = time.monotonic()
@@ -382,3 +386,74 @@ def test_feeder_with_sharded_aggregator():
     profiles = {p.pid: p.total() for p in agg._build_profiles(snap, counts)}
     oracle = {p.pid: p.total() for p in CPUAggregator().aggregate(snap)}
     assert profiles == oracle
+
+
+def test_first_feed_gets_the_compile_budget_then_short_timeout():
+    """The first feed of a cold process includes the XLA compile of the
+    feed program, so it gets first_feed_timeout_s; once one feed has
+    succeeded, the short feed_timeout_s guards every later feed."""
+    import threading
+    import time
+
+    snap = _snap(seed=9, n=60, pids=2)
+    slow_s = {"v": 0.5}
+
+    class Slow(DictAggregator):
+        def feed(self, *a, **kw):
+            time.sleep(slow_s["v"])
+            return super().feed(*a, **kw)
+
+    agg = Slow(capacity=1 << 10)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs(),
+                                   feed_timeout_s=0.2,
+                                   first_feed_timeout_s=5.0)
+    # First feed: slower than feed_timeout_s but inside the first-feed
+    # budget — must SUCCEED (this is the compile-on-first-feed case that
+    # would otherwise disable streaming on every cold TPU start).
+    feeder.on_drain(_cols(snap, 0, 30))
+    assert not feeder.disabled
+    assert feeder.stats["drains_fed"] == 1
+    # Later feeds run under the short timeout: the same slowness now
+    # trips the watchdog and starts the cooldown.
+    feeder.on_drain(_cols(snap, 30, 60))
+    assert feeder.disabled
+
+
+def test_wedged_boot_pays_the_long_budget_exactly_once():
+    """A device wedged from boot costs ONE long first-feed stall; every
+    re-probe after the cooldown runs under the short timeout (the old
+    behavior re-paid the long budget on each re-probe, stalling the
+    capture loop and wrapping the perf rings repeatedly)."""
+    import threading
+    import time
+
+    snap = _snap(seed=12, n=50, pids=2)
+    release = threading.Event()
+
+    class Wedge(DictAggregator):
+        def feed(self, *a, **kw):
+            release.wait(30)
+
+    agg = Wedge(capacity=1 << 10)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs(),
+                                   feed_timeout_s=0.1,
+                                   first_feed_timeout_s=0.5,
+                                   reprobe_base_windows=1)
+    t0 = time.monotonic()
+    feeder.on_drain(_cols(snap, 0, 25))        # first attempt: long budget
+    first_stall = time.monotonic() - t0
+    assert feeder.disabled
+    assert 0.4 < first_stall < 5
+    release.set()                               # let the abandoned call die
+    for _ in range(100):
+        if not feeder.device_blocked():
+            break
+        time.sleep(0.05)
+    release.clear()
+    feeder.take_window_if_complete(snap)        # cooldown 1 -> re-enabled
+    assert not feeder.disabled
+    t0 = time.monotonic()
+    feeder.on_drain(_cols(snap, 25, 50))        # re-probe: SHORT budget
+    assert time.monotonic() - t0 < 0.4
+    assert feeder.disabled
+    release.set()

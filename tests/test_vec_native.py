@@ -144,3 +144,26 @@ def test_padded_width_out_of_range_rejected_both_paths(native_lib,
         with pytest.raises(ValueError):
             vec.put_varints_padded(out, pos, vals, width)
     assert not out.any()
+
+
+def test_native_build_failure_falls_back_with_a_warning(monkeypatch):
+    """A failed native build must land on the numpy path (with one log
+    warning), never raise out of the varint helpers mid-encode."""
+    from parca_agent_tpu import native as native_mod
+
+    def boom(*a, **kw):
+        raise RuntimeError("no toolchain")
+
+    monkeypatch.setattr(vec, "_native", False)   # force a fresh load
+    monkeypatch.setattr(native_mod, "ensure_built", boom)
+    try:
+        vals = np.array([1, 300, 1 << 40], np.uint64)
+        lens = vec.varint_len(vals)              # first call hits the except
+        out = np.zeros(int(lens.sum()), np.uint8)
+        pos = np.zeros(3, np.int64)
+        np.cumsum(lens[:-1], out=pos[1:])
+        vec.put_varints(out, pos, vals)
+        assert out.any()
+        assert vec._load_native() is None        # pinned to the fallback
+    finally:
+        monkeypatch.setattr(vec, "_native", False)  # don't poison others
