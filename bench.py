@@ -319,6 +319,11 @@ def run(emit=None) -> dict:
         for k, v in agg.timings.items():
             phase_samples.setdefault(k, []).append(v)
         assert int(counts.sum()) == total
+        # Per-rep forensics: if the tunnel dies mid-reps the attempt
+        # times out with no JSON line, and these are the only record
+        # of the closes that DID complete on the device.
+        _progress(f"close rep {len(close_times)}: "
+                  f"{close_times[-1] * 1e3:.1f} ms")
     tpu_ms = _median_ms(close_times)
     # Per-phase MEDIANS across reps (a single rep's snapshot mixes one
     # slow tunnel transfer or a stale warmup value into the breakdown),
