@@ -396,11 +396,19 @@ def run(emit=None) -> dict:
             from parca_agent_tpu.pprof.window_encoder import WindowEncoder
 
             enc = WindowEncoder(agg)
+            # Warm windows HIDE 5% of the stacks so the later churn
+            # window genuinely exercises the append path (new template
+            # rows), not just the zero-patch path.
+            rng = np.random.default_rng(7)
+            base_counts = np.asarray(counts).copy()
+            hidden = rng.random(len(base_counts)) < 0.05
+            warm = base_counts.copy()
+            warm[hidden] = 0
             t0 = time.perf_counter()
             n_built = enc.build_statics(snap.period_ns)
             statics_ms = (time.perf_counter() - t0) * 1e3
             t0 = time.perf_counter()
-            out = enc.encode(counts, snap.time_ns, snap.window_ns,
+            out = enc.encode(warm, snap.time_ns, snap.window_ns,
                              snap.period_ns)
             first_ms = (time.perf_counter() - t0) * 1e3
             out_bytes = sum(len(b) for _, b in out)
@@ -409,13 +417,32 @@ def run(emit=None) -> dict:
                 if rep_idle_s:
                     time.sleep(rep_idle_s)
                 t0 = time.perf_counter()
-                out = enc.encode(counts, snap.time_ns + k + 1,
+                out = enc.encode(warm, snap.time_ns + k + 1,
                                  snap.window_ns, snap.period_ns)
                 enc_times.append(time.perf_counter() - t0)
             assert "encode_patch" in enc.timings  # template path engaged
             pprof_ms = _median_ms(enc_times)
+            # CHURN window: 10% of the warm stacks go cold, the hidden 5%
+            # APPEAR (append/relocate machinery), the rest move — the
+            # realistic production regime (no two windows share a live
+            # set). Must still ride the template patch path.
+            churn = base_counts.copy()
+            churn[(rng.random(len(churn)) < 0.1) & ~hidden] = 0
+            churn[churn > 0] += 1
+            rows_before = enc._tmpl.n_rows
+            enc.timings.clear()
+            t0 = time.perf_counter()
+            out_c = enc.encode(churn, snap.time_ns + 9, snap.window_ns,
+                               snap.period_ns)
+            churn_ms = (time.perf_counter() - t0) * 1e3
+            churn_patched = "encode_build" not in enc.timings
+            appended = int(enc._tmpl.n_rows - rows_before)
+            del out_c, churn
             extras["pprof"] = {
                 "encode_ms": round(pprof_ms, 1),
+                "encode_churn_ms": round(churn_ms, 1),
+                "churn_on_patch_path": churn_patched,
+                "churn_appended_rows": appended,
                 "statics_build_ms": round(statics_ms, 1),
                 "first_encode_ms": round(first_ms, 1),
                 "profiles": len(out),

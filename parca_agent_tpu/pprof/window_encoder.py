@@ -172,22 +172,56 @@ class _PidStatic:
 
 class _Template:
     """Cached whole-window serialization: every pid's profile bytes laid
-    out back to back in one uint8 buffer, with the positions of the only
-    per-window-variable bytes (fixed-width count varints and the shared
-    time/duration fields) recorded so the next window with the same live
-    stack set is a patch, not a re-serialization."""
+    out in one uint8 buffer, one independent blob slice per pid, with the
+    positions of the per-window-variable bytes (fixed-width count varints
+    and the shared time/duration fields) recorded.
 
-    __slots__ = ("buf", "idx", "pid_bounds", "pids", "val_pos",
-                 "time_pos", "static_gen", "period_ns")
+    The template survives WINDOW CHURN, not just identical windows:
+
+      * a template row whose stack got no samples this window is patched
+        to count 0 (legal protobuf, same profile semantics) instead of
+        forcing a relayout;
+      * new stacks append sample rows into per-pid slack reserved at
+        build time (protobuf field order is free, so appended rows after
+        the time fields are legal), and new location messages append the
+        registry's append-only delta the same way;
+      * a pid whose slack is exhausted (or whose head/tail statics
+        changed) relocates its blob to the end of the buffer — blobs are
+        independent slices, their order in the buffer is meaningless —
+        leaving a hole that is accounted as waste;
+      * a full rebuild happens only when dead rows, waste, or the append
+        volume cross thresholds (see encode()).
+
+    Without this, every real window (where SOME stack goes cold or new
+    stacks appear — i.e. all of them) would pay the full relayout; the
+    patch path would only ever serve the bench's repeated identical
+    window."""
+
+    __slots__ = ("buf", "n_rows", "row_of", "row_id", "row_group",
+                 "val_pos", "pids", "blob_start", "blob_end", "cap_end",
+                 "time_pos", "group_of", "g_head_len", "g_tail_len",
+                 "g_loc_len", "alloc_end", "waste", "rotations",
+                 "period_ns")
 
     def __init__(self):
         self.buf = None          # np.uint8 big buffer
-        self.idx = None          # live stack ids this layout serves
-        self.pid_bounds = None   # int64 [G+1] blob boundaries in buf
+        self.n_rows = 0          # sample rows currently in the template
+        self.row_of = None       # int64 [>=synced] id -> row (-1 absent)
+        self.row_id = None       # int64 [n_rows] row -> id
+        self.row_group = None    # int32 [n_rows] row -> group
+        self.val_pos = None      # int64 [n_rows] count-varint positions
         self.pids = None         # int32 [G]
-        self.val_pos = None      # int64 [S] count-varint positions
+        self.blob_start = None   # int64 [G] blob slice starts
+        self.blob_end = None     # int64 [G] blob slice ends (exclusive)
+        self.cap_end = None      # int64 [G] region capacity limits
         self.time_pos = None     # int64 [G] per-pid time-field positions
-        self.static_gen = -1
+        self.group_of = None     # dict pid -> group index
+        self.g_head_len = None   # int64 [G] static head bytes in blob
+        self.g_tail_len = None   # int64 [G] static tail bytes in blob
+        self.g_loc_len = None    # int64 [G] location bytes in blob
+        self.alloc_end = 0       # buffer high-water mark
+        self.waste = 0           # relocation holes, bytes
+        self.rotations = -1      # aggregator rotation epoch at build
         self.period_ns = -1      # period the cached statics embed
 
 
@@ -231,7 +265,6 @@ class WindowEncoder:
         self._order = None               # ids sorted by pid (int64)
         self._order_pid = None           # pid per sorted slot (int32)
         self._static: dict[int, _PidStatic] = {}
-        self._static_gen = 0             # bumps on any static rebuild
         self._tmpl = _Template()
         self.timings: dict[str, float] = {}
 
@@ -248,7 +281,6 @@ class WindowEncoder:
             self._synced = 0
             self._pre_off[0] = 0
             self._static.clear()
-            self._static_gen += 1
             self._order = None
         n = agg._next_id
         if n > self._synced:
@@ -342,7 +374,6 @@ class WindowEncoder:
         st.tail = bytes(tail)
         st.n_mappings = len(reg.mappings)
         st.period_ns = period_ns
-        self._static_gen += 1
 
     def _ensure_static(self, pid: int, period_ns: int) -> _PidStatic:
         agg = self._agg
@@ -360,7 +391,6 @@ class WindowEncoder:
             buf, _ = _encode_location_stream(ids, mids, addrs)
             st.loc_bytes.extend(buf.tobytes())
             st.n_locs = n_locs
-            self._static_gen += 1
         return st
 
     def _build_tails_batch(self, tables, cpu_idx, nano_idx,
@@ -471,7 +501,6 @@ class WindowEncoder:
             st.tail = tails[k]
             st.period_ns = period_ns
             st.n_mappings = len(reg.mappings)
-        self._static_gen += 1
 
     def _build_locs_batch(self, dirty) -> None:
         """One vectorized location pass over a batch of (static, registry,
@@ -502,7 +531,6 @@ class WindowEncoder:
             st.loc_bytes.extend(
                 mv[int(offs[bounds[k]]): int(offs[bounds[k + 1]])])
             st.n_locs = n
-        self._static_gen += 1
 
     def build_statics(self, period_ns: int, budget_s: float | None = None,
                       chunk: int = 4096, loc_chunk: int = 1 << 18) -> int:
@@ -573,7 +601,9 @@ class WindowEncoder:
     def _build_layout(self, idx: np.ndarray, pids_live: np.ndarray,
                       period_ns: int) -> None:
         """Serialize the full window layout (everything except the count and
-        time values, which are patched after) and record patch positions."""
+        time values, which are patched after) and record patch positions.
+        Each pid's region is over-allocated with slack so later windows can
+        APPEND new stacks' rows instead of relaying out (see _Template)."""
         tmpl = self._tmpl
         bounds = np.flatnonzero(np.diff(pids_live)) + 1
         gstarts = np.concatenate(([0], bounds))
@@ -601,16 +631,20 @@ class WindowEncoder:
         gsizes = gends - gstarts
         samples_per_g = stream_off[gends] - stream_off[gstarts]
         blob_lens = samples_per_g + static_lens + _WTAIL_LEN
-        pid_bounds = np.zeros(len(pids) + 1, np.int64)
-        np.cumsum(blob_lens, out=pid_bounds[1:])
+        # Append slack per pid (~12.5%, min 64 B): garbage bytes BETWEEN
+        # blob slices cost nothing on the wire.
+        caps = blob_lens + np.maximum(blob_lens >> 3, 64)
+        cap_bounds = np.zeros(len(pids) + 1, np.int64)
+        np.cumsum(caps, out=cap_bounds[1:])
 
-        total = int(pid_bounds[-1])
+        total = int(cap_bounds[-1])
         buf = tmpl.buf
         if buf is None or len(buf) < total:
             buf = np.empty(int(total * 1.05) + 64, np.uint8)
+        blob_start = cap_bounds[:-1]
         # Each group's sample run starts at its blob start: shift the
         # packed stream offsets group-wise.
-        shift = pid_bounds[:-1] - stream_off[gstarts]
+        shift = blob_start - stream_off[gstarts]
         p = stream_off[:-1] + np.repeat(shift, gsizes)
         buf[p] = _TAG_SAMPLE
         put_varints(buf, p + 1, body_len.astype(np.uint64), l_body)
@@ -620,9 +654,9 @@ class WindowEncoder:
         buf[vp] = _TAG_S_VALUE
         buf[vp + 1] = self._VAL_W
 
-        time_pos = pid_bounds[:-1] + samples_per_g + static_lens
+        time_pos = blob_start + samples_per_g + static_lens
         for g, s in enumerate(statics):
-            a = int(pid_bounds[g] + samples_per_g[g])
+            a = int(blob_start[g] + samples_per_g[g])
             for part in (s.head, s.loc_bytes, s.tail):
                 lp = len(part)
                 if lp:
@@ -632,11 +666,212 @@ class WindowEncoder:
         buf[time_pos + 1 + self._TIME_W] = (P_DURATION_NANOS << 3)
 
         tmpl.buf = buf
-        tmpl.idx = idx.copy()
-        tmpl.pid_bounds = pid_bounds
-        tmpl.pids = pids
+        tmpl.n_rows = len(idx)
+        row_of = np.full(max(self._synced, 1), -1, np.int64)
+        row_of[idx] = np.arange(len(idx), dtype=np.int64)
+        tmpl.row_of = row_of
+        tmpl.row_id = idx.astype(np.int64, copy=True)
+        tmpl.row_group = np.repeat(
+            np.arange(len(pids), dtype=np.int32), gsizes)
         tmpl.val_pos = vp + 2
+        tmpl.pids = pids
+        tmpl.blob_start = blob_start.copy()
+        tmpl.blob_end = blob_start + blob_lens
+        tmpl.cap_end = cap_bounds[1:].copy()
         tmpl.time_pos = time_pos
+        tmpl.group_of = {int(pid): g for g, pid in enumerate(pids.tolist())}
+        tmpl.g_head_len = np.array([len(s.head) for s in statics], np.int64)
+        tmpl.g_tail_len = np.array([len(s.tail) for s in statics], np.int64)
+        tmpl.g_loc_len = np.array(
+            [len(s.loc_bytes) for s in statics], np.int64)
+        tmpl.alloc_end = total
+        tmpl.waste = 0
+        tmpl.rotations = self._rotations
+
+    # -- incremental append (the churn path) ---------------------------------
+
+    def _ensure_buf(self, extra: int) -> None:
+        """Grow the template buffer so `extra` bytes fit at alloc_end."""
+        tmpl = self._tmpl
+        need = tmpl.alloc_end + extra
+        if need > len(tmpl.buf):
+            grown = np.empty(int(need * 1.3) + 64, np.uint8)
+            grown[: tmpl.alloc_end] = tmpl.buf[: tmpl.alloc_end]
+            tmpl.buf = grown
+
+    def _serialize_rows(self, ids: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Sample-row bytes for `ids`, packed back to back: returns
+        (stream, row starts, value-varint positions), all stream-relative.
+        Value bytes are left zeroed — encode() patches every row's count
+        after any appends, so they never reach a parser unpatched."""
+        pre_lens = self._pre_off[ids + 1] - self._pre_off[ids]
+        body_len = pre_lens + 2 + self._VAL_W
+        l_body = varint_len(body_len.astype(np.uint64))
+        samp_lens = 1 + l_body + body_len
+        s_off = np.zeros(len(ids) + 1, np.int64)
+        np.cumsum(samp_lens, out=s_off[1:])
+        stream = np.zeros(int(s_off[-1]), np.uint8)
+        p = s_off[:-1]
+        stream[p] = _TAG_SAMPLE
+        put_varints(stream, p + 1, body_len.astype(np.uint64), l_body)
+        ragged_gather(self._pre_flat, self._pre_off[ids], pre_lens,
+                      out=stream, out_starts=p + 1 + l_body)
+        vp = p + 1 + l_body + pre_lens
+        stream[vp] = _TAG_S_VALUE
+        stream[vp + 1] = self._VAL_W
+        return stream, s_off, vp + 2
+
+    def _append_rows(self, new_ids: np.ndarray, new_pids: np.ndarray,
+                     period_ns: int) -> None:
+        """Add sample rows for stacks the template has never seen, without
+        touching any other pid's bytes: rows (and the location registry's
+        append-only delta) go into the owning pid's slack; a pid without
+        room — or whose head/tail statics changed — relocates its blob to
+        the buffer's end (blob order is meaningless); a brand-new pid gets
+        a fresh blob. encode() patches every count afterwards."""
+        tmpl = self._tmpl
+        # Batch-build dirty statics first (new stacks usually mean new
+        # locations for their pids); the per-pid _ensure_static below is
+        # then a cache hit — the same reasoning as _build_layout's.
+        self.build_statics(period_ns)
+        stream, s_off, vp_rel = self._serialize_rows(new_ids)
+        bounds = np.flatnonzero(np.diff(new_pids)) + 1
+        gstarts = np.concatenate(([0], bounds)).tolist()
+        gends = np.concatenate((bounds, [len(new_ids)])).tolist()
+        n0 = tmpl.n_rows
+        add_val_pos = np.empty(len(new_ids), np.int64)
+        add_group = np.empty(len(new_ids), np.int32)
+        pend: list[tuple] = []  # deferred new-group records (pid, blob
+        #                         geometry) — one concatenate per array
+        #                         after the loop, not one np.append each
+        for gs, ge in zip(gstarts, gends):
+            pid = int(new_pids[gs])
+            st = self._ensure_static(pid, period_ns)
+            g = tmpl.group_of.get(pid)
+            lo, hi = int(s_off[gs]), int(s_off[ge])
+            if g is not None \
+                    and len(st.head) == int(tmpl.g_head_len[g]) \
+                    and len(st.tail) == int(tmpl.g_tail_len[g]):
+                loc_delta = len(st.loc_bytes) - int(tmpl.g_loc_len[g])
+                need = (hi - lo) + loc_delta
+                if tmpl.cap_end[g] - tmpl.blob_end[g] < need:
+                    self._relocate_blob(g, need)
+                dest = int(tmpl.blob_end[g])
+                buf = tmpl.buf
+                buf[dest: dest + (hi - lo)] = stream[lo:hi]
+                if loc_delta:
+                    buf[dest + (hi - lo): dest + need] = np.frombuffer(
+                        st.loc_bytes, np.uint8,
+                        loc_delta, int(tmpl.g_loc_len[g]))
+                    tmpl.g_loc_len[g] += loc_delta
+                tmpl.blob_end[g] += need
+                add_val_pos[gs:ge] = dest + (vp_rel[gs:ge] - lo)
+            else:
+                # Head/tail changed (mapping growth, comm change) or a
+                # brand-new pid: (re)write the whole blob at the end.
+                if g is not None:
+                    rows_g = np.flatnonzero(
+                        tmpl.row_group[:n0] == g).astype(np.int64)
+                    ids_all = np.concatenate(
+                        (tmpl.row_id[rows_g], new_ids[gs:ge]))
+                else:
+                    rows_g = np.empty(0, np.int64)
+                    ids_all = new_ids[gs:ge].astype(np.int64)
+                g, vp_abs = self._write_pid_blob(
+                    g, pid, ids_all, rows_g, st,
+                    pend=pend, next_g=len(tmpl.pids) + len(pend))
+                # _write_pid_blob set val_pos for the existing rows; the
+                # new rows' positions follow directly after them.
+                add_val_pos[gs:ge] = vp_abs[len(rows_g):]
+            add_group[gs:ge] = g
+        if pend:
+            # Register the deferred new groups: one concatenate per array
+            # for the whole window, not one np.append per new pid.
+            cols = list(zip(*pend))
+            tmpl.pids = np.concatenate(
+                (tmpl.pids, np.array(cols[0], np.int32)))
+            for slot, col in zip(("blob_start", "blob_end", "cap_end",
+                                  "time_pos", "g_head_len", "g_tail_len",
+                                  "g_loc_len"), cols[1:]):
+                setattr(tmpl, slot, np.concatenate(
+                    (getattr(tmpl, slot), np.array(col, np.int64))))
+        # Register the new rows (one concatenate per array per window).
+        tmpl.row_id = np.concatenate((tmpl.row_id[:n0], new_ids))
+        tmpl.row_group = np.concatenate((tmpl.row_group[:n0], add_group))
+        tmpl.val_pos = np.concatenate((tmpl.val_pos[:n0], add_val_pos))
+        tmpl.row_of[new_ids] = np.arange(n0, n0 + len(new_ids),
+                                         dtype=np.int64)
+        tmpl.n_rows = n0 + len(new_ids)
+
+    def _relocate_blob(self, g: int, extra: int) -> None:
+        """Move group g's blob to the end of the buffer with fresh slack
+        sized for `extra` more bytes; the old region becomes waste."""
+        tmpl = self._tmpl
+        start, end = int(tmpl.blob_start[g]), int(tmpl.blob_end[g])
+        blob_len = end - start
+        cap = blob_len + extra + max((blob_len + extra) >> 3, 64)
+        self._ensure_buf(cap)
+        new_start = tmpl.alloc_end
+        buf = tmpl.buf
+        buf[new_start: new_start + blob_len] = buf[start:end]
+        delta = new_start - start
+        rows_g = tmpl.row_group[: tmpl.n_rows] == g
+        tmpl.val_pos[: tmpl.n_rows][rows_g] += delta
+        tmpl.time_pos[g] += delta
+        tmpl.waste += int(tmpl.cap_end[g]) - start
+        tmpl.blob_start[g] = new_start
+        tmpl.blob_end[g] = new_start + blob_len
+        tmpl.cap_end[g] = new_start + cap
+        tmpl.alloc_end = new_start + cap
+
+    def _write_pid_blob(self, g: int | None, pid: int, ids_all: np.ndarray,
+                        rows_g: np.ndarray, st, pend: list | None = None,
+                        next_g: int = -1) -> tuple[int, np.ndarray]:
+        """Serialize pid's complete blob (samples + statics + time fields)
+        at the buffer's end. Rewrites val_pos for the pid's existing rows
+        (`rows_g`, in row order = the first len(rows_g) entries of
+        `ids_all`); returns (group index, absolute value positions for
+        every row of `ids_all`). A brand-new pid (g is None) is assigned
+        `next_g` and its group arrays are DEFERRED onto `pend` — the
+        caller registers all of a window's new groups in one concatenate
+        per array."""
+        tmpl = self._tmpl
+        stream, s_off, vp_rel = self._serialize_rows(ids_all)
+        static_len = len(st.head) + len(st.loc_bytes) + len(st.tail)
+        blob_len = int(s_off[-1]) + static_len + _WTAIL_LEN
+        cap = blob_len + max(blob_len >> 3, 64)
+        self._ensure_buf(cap)
+        base = tmpl.alloc_end
+        buf = tmpl.buf
+        buf[base: base + int(s_off[-1])] = stream
+        a = base + int(s_off[-1])
+        for part in (st.head, st.loc_bytes, st.tail):
+            lp = len(part)
+            if lp:
+                buf[a: a + lp] = np.frombuffer(part, np.uint8)
+                a += lp
+        tpos = a
+        buf[tpos] = (P_TIME_NANOS << 3)
+        buf[tpos + 1 + self._TIME_W] = (P_DURATION_NANOS << 3)
+        if g is None:
+            g = next_g
+            pend.append((pid, base, base + blob_len, base + cap, tpos,
+                         len(st.head), len(st.tail), len(st.loc_bytes)))
+            tmpl.group_of[pid] = g
+        else:
+            tmpl.waste += int(tmpl.cap_end[g]) - int(tmpl.blob_start[g])
+            tmpl.blob_start[g] = base
+            tmpl.blob_end[g] = base + blob_len
+            tmpl.cap_end[g] = base + cap
+            tmpl.time_pos[g] = tpos
+            tmpl.g_head_len[g] = len(st.head)
+            tmpl.g_tail_len[g] = len(st.tail)
+            tmpl.g_loc_len[g] = len(st.loc_bytes)
+            if len(rows_g):
+                tmpl.val_pos[rows_g] = base + vp_rel[: len(rows_g)]
+        tmpl.alloc_end = base + cap
+        return g, base + vp_rel
 
     def encode(self, counts: np.ndarray, time_ns: int, duration_ns: int,
                period_ns: int, views: bool = False) -> list[tuple[int, bytes]]:
@@ -676,18 +911,48 @@ class WindowEncoder:
         tmpl = self._tmpl
         t0 = _time.perf_counter()
         hit = (tmpl.buf is not None
-               and tmpl.static_gen == self._static_gen
                and tmpl.period_ns == period_ns
-               and tmpl.idx is not None
-               and len(tmpl.idx) == len(idx)
-               and bool(np.array_equal(tmpl.idx, idx)))
+               and tmpl.rotations == self._rotations)
+        if hit:
+            # Churn analysis against the template's row set. row_of may
+            # lag the id space (population grew since the build).
+            row = tmpl.row_of[idx] if int(idx.max()) < len(tmpl.row_of) \
+                else None
+            if row is None:
+                known = np.zeros(len(idx), bool)
+                known_ok = tmpl.row_of[idx[idx < len(tmpl.row_of)]]
+                n_new = len(idx) - int((known_ok >= 0).sum())
+            else:
+                known = row >= 0
+                n_new = len(idx) - int(known.sum())
+            dead = tmpl.n_rows - (len(idx) - n_new)
+            # Rebuild when the patch path stops paying: mostly-dead
+            # template (wire bloat from zero rows), append volume near a
+            # relayout's, or relocation holes dominating the buffer.
+            hit = (dead <= tmpl.n_rows // 2
+                   and n_new <= max(tmpl.n_rows // 2, 1024)
+                   and tmpl.waste <= tmpl.alloc_end // 3)
         if not hit:
             self._build_layout(idx, pids_live, period_ns)
-            tmpl.static_gen = self._static_gen  # statics built along the way
             tmpl.period_ns = period_ns
+            row = tmpl.row_of[idx]
+        else:
+            if row is None or (n_new and len(tmpl.row_of) < self._synced):
+                grown = np.full(max(self._synced, 1), -1, np.int64)
+                grown[: len(tmpl.row_of)] = tmpl.row_of
+                tmpl.row_of = grown
+                row = tmpl.row_of[idx]
+                known = row >= 0
+            if n_new:
+                self._append_rows(idx[~known], pids_live[~known], period_ns)
+                row = tmpl.row_of[idx]
         buf = tmpl.buf
-        # Patch the per-window values (on a template hit this IS the encode).
-        put_varints_padded(buf, tmpl.val_pos, vals, self._VAL_W)
+        # Patch the per-window values (on a template hit this IS the
+        # encode). Template rows with no samples this window are patched
+        # to zero — semantically the same profile, no relayout.
+        vals_full = np.zeros(tmpl.n_rows, np.uint64)
+        vals_full[row] = vals
+        put_varints_padded(buf, tmpl.val_pos, vals_full, self._VAL_W)
         tp = tmpl.time_pos
         w10 = np.arange(self._TIME_W, dtype=np.int64)
         buf[tp[:, None] + 1 + w10[None, :]] = \
@@ -698,20 +963,29 @@ class WindowEncoder:
             _time.perf_counter() - t0
 
         t0 = _time.perf_counter()
-        pb = tmpl.pid_bounds
+        bs, be = tmpl.blob_start, tmpl.blob_end
+        # A pid whose every template row is dead this window would emit an
+        # all-zero profile — the reference never writes a sample-less
+        # profile, so skip those groups (their blobs stay for the next
+        # window they wake up in).
+        live_g = np.zeros(len(tmpl.pids), bool)
+        live_g[tmpl.row_group[row]] = True
         pid_list = tmpl.pids.tolist()
         out: list[tuple[int, bytes]] = []
         if self._compress:
             mv = buf.data
             for g, pid in enumerate(pid_list):
-                out.append((pid, _gzip.compress(
-                    bytes(mv[int(pb[g]): int(pb[g + 1])]), 1)))
+                if live_g[g]:
+                    out.append((pid, _gzip.compress(
+                        bytes(mv[int(bs[g]): int(be[g])]), 1)))
         elif views:
             mv = buf.data
             for g, pid in enumerate(pid_list):
-                out.append((pid, mv[int(pb[g]): int(pb[g + 1])]))
+                if live_g[g]:
+                    out.append((pid, mv[int(bs[g]): int(be[g])]))
         else:
             for g, pid in enumerate(pid_list):
-                out.append((pid, buf[int(pb[g]): int(pb[g + 1])].tobytes()))
+                if live_g[g]:
+                    out.append((pid, buf[int(bs[g]): int(be[g])].tobytes()))
         self.timings["encode_emit"] = _time.perf_counter() - t0
         return out
