@@ -100,8 +100,12 @@ class CPUProfiler:
             # window at large pid populations.
             streaming_feeder.attach_encoder(self._encoder)
             # While an abandoned AGGREGATION call (hang watchdog, below)
-            # may still be executing, it can be inside encoder.encode();
-            # gate the feeder's polling-thread touches on it.
+            # may still be executing inside take_window_if_complete() /
+            # window_counts(), it shares registry state the encoder
+            # reads; gate the feeder's polling-thread touches on it.
+            # (encode() itself runs on the profiler thread OUTSIDE the
+            # watchdog — host numpy cannot hang on the device — so an
+            # abandoned call can never be inside encode().)
             streaming_feeder.external_blocked = (
                 lambda: self._device_inflight is not None
                 and not self._device_inflight.is_set())
@@ -349,9 +353,13 @@ class CPUProfiler:
 
     def _aggregate_encode_write(self, snapshot: WindowSnapshot) -> int:
         """Fast path: counts -> vectorized encoder -> writer, no PidProfile
-        materialization. The device call rides the same hang watchdog as
-        the classic path; on failure/hang the CPU fallback aggregates and
-        writes through the scalar builder."""
+        materialization. ONLY the device call rides the hang watchdog (on
+        failure/hang the CPU fallback aggregates and writes through the
+        scalar builder); the encoder is host-side numpy — it cannot hang
+        on the device, and its slow transients (a post-rotation template
+        rebuild is tens of seconds at 50k pids) must not eat the device
+        watchdog's budget and read as a wedged device. An encoder FAILURE
+        still falls back to the scalar path for that window."""
         t0 = time.perf_counter()
         self._windows_seen += 1  # hang-cooldown clock (obtain_profiles' twin)
 
@@ -368,14 +376,24 @@ class CPUProfiler:
                 counts = self._feeder.take_window_if_complete(snapshot)
             if counts is None:  # not streamed (or incomplete): one-shot
                 counts = self._aggregator.window_counts(snapshot)
-            return "enc", self._encoder.encode(
-                counts, snapshot.time_ns, snapshot.window_ns,
-                snapshot.period_ns)
+            return "counts", counts
 
         def fallback():
             return "prof", self._fallback.aggregate(snapshot)
 
         kind, out = self._guarded(fast, fallback)
+        if kind == "counts":
+            try:
+                out = self._encoder.encode(
+                    out, snapshot.time_ns, snapshot.window_ns,
+                    snapshot.period_ns)
+                kind = "enc"
+            except Exception as e:  # noqa: BLE001 - window must still ship
+                if self._fallback is None:
+                    raise
+                _log.warn("fast encode failed; scalar fallback for this "
+                          "window", error=repr(e))
+                kind, out = fallback()
         self.metrics.last_aggregate_duration_s = time.perf_counter() - t0
         self.metrics.samples_aggregated += snapshot.total_samples()
         if kind == "prof":

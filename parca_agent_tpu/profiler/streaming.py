@@ -96,19 +96,21 @@ class StreamingWindowFeeder:
         # the close-time statics transient (a cold 50k-pid first window
         # otherwise pays the full build inside the close) to one budget.
         # Pure host numpy, and race-free by construction: the sampler's
-        # poll() invokes the tee synchronously on the profiler thread, and
-        # the profiler's encode runs while that same thread blocks in its
-        # watchdog wait — tee and encode never overlap except when a
-        # timed-out encode is ABANDONED, which external_blocked gates.
+        # poll() invokes the tee synchronously on the profiler thread,
+        # and the profiler's encode also runs on the profiler thread
+        # (outside the device watchdog) — tee and encode literally cannot
+        # overlap. external_blocked gates the remaining hazard: an
+        # abandoned DEVICE aggregation call that shares registry state.
         self._encoder = None
         self._prebuild_period = prebuild_period_ns
         self._prebuild_budget = prebuild_budget_s
         # Optional external gate (the profiler wires its hang-watchdog
         # state here): while an ABANDONED AGGREGATION call may still be
-        # executing — it can be inside encoder.encode()/window_counts() —
-        # neither the aggregator nor the encoder may be touched from the
-        # polling thread, so on_drain skips entirely (the incomplete fed
-        # mass then makes the window fall back, which is exactly right).
+        # executing inside take_window_if_complete()/window_counts(),
+        # neither the aggregator nor the encoder (which reads the
+        # aggregator's registry) may be touched from the polling thread,
+        # so on_drain skips entirely (the incomplete fed mass then makes
+        # the window fall back, which is exactly right).
         self.external_blocked = None
         self.stats = {"drains_fed": 0, "windows_streamed": 0,
                       "windows_fallback": 0, "reprobes": 0,

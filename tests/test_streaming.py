@@ -457,3 +457,98 @@ def test_wedged_boot_pays_the_long_budget_exactly_once():
     assert time.monotonic() - t0 < 0.4
     assert feeder.disabled
     release.set()
+
+
+def test_encode_failure_falls_back_scalar_not_device_watchdog():
+    """The encoder is host-side numpy: its failures (and slow transients,
+    e.g. a post-rotation template rebuild) run OUTSIDE the device hang
+    watchdog. A raising encoder costs one scalar-fallback window — it must
+    not mark the device wedged."""
+    from parca_agent_tpu.capture.replay import ReplaySource
+
+    snap = _snap(seed=13)
+
+    class Collect:
+        def __init__(self):
+            self.got = []
+
+        def write(self, labels, blob):
+            self.got.append((labels, blob))
+
+    agg = DictAggregator(capacity=1 << 11)
+    w = Collect()
+    p = CPUProfiler(source=ReplaySource([snap, snap]), aggregator=agg,
+                    fallback_aggregator=CPUAggregator(),
+                    profile_writer=w, fast_encode=True)
+
+    boom = {"on": True}
+    real_encode = p._encoder.encode
+
+    def maybe_boom(*a, **kw):
+        if boom["on"]:
+            raise RuntimeError("encoder bug")
+        return real_encode(*a, **kw)
+
+    p._encoder.encode = maybe_boom
+    assert p.run_iteration()
+    assert p.last_error is None          # window still shipped (scalar)
+    assert len(w.got) > 0
+    assert p._device_wedged_at is None   # device NOT blamed
+    n_scalar = len(w.got)
+    # Next window: encoder healthy again, fast path resumes seamlessly.
+    boom["on"] = False
+    assert p.run_iteration()
+    assert len(w.got) > n_scalar
+
+
+def test_slow_encode_does_not_trip_the_device_watchdog():
+    """The new invariant of the fast path's structure: encode runs on the
+    profiler thread OUTSIDE the device hang watchdog, so an encode slower
+    than device_timeout_s (a post-rotation template rebuild is tens of
+    seconds at 50k pids) ships fast-path profiles and never marks the
+    device wedged. (With encode inside the guarded thunk, this test
+    times out the watchdog and fails on _device_wedged_at.)"""
+    import time as _t
+
+    from parca_agent_tpu.capture.replay import ReplaySource
+
+    snap = _snap(seed=14)
+
+    class Collect:
+        def __init__(self):
+            self.got = []
+
+        def write(self, labels, blob):
+            self.got.append((labels, blob))
+
+    agg = DictAggregator(capacity=1 << 11)
+    w = Collect()
+    p = CPUProfiler(source=ReplaySource([snap, snap]), aggregator=agg,
+                    fallback_aggregator=CPUAggregator(),
+                    profile_writer=w, fast_encode=True)
+    # Warm iteration with the default device budget: the one-shot
+    # window_counts XLA compile must not be what trips the tiny timeout
+    # below — this test is about the ENCODE being outside the watchdog.
+    assert p.run_iteration()
+    assert p._device_wedged_at is None
+    w.got.clear()
+
+    real_encode = p._encoder.encode
+
+    def slow_encode(*a, **kw):
+        _t.sleep(0.5)                    # slower than device_timeout_s
+        return real_encode(*a, **kw)
+
+    p._encoder.encode = slow_encode
+    p._device_timeout = 0.15
+    assert p.run_iteration()
+    assert p.last_error is None
+    assert p._device_wedged_at is None   # slow ENCODE is not a wedged DEVICE
+    assert len(w.got) > 0
+    # Fast-path blobs, not scalar-fallback profiles: parseable bytes with
+    # the window's full mass.
+    from parca_agent_tpu.pprof.builder import parse_pprof
+
+    total = sum(sum(v[0] for _, v, _ in parse_pprof(b).samples)
+                for _, b in w.got)
+    assert total == snap.total_samples()
