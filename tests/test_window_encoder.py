@@ -329,3 +329,47 @@ def test_encoder_heavy_churn_rebuilds():
     out = enc.encode(c2, snap.time_ns, snap.window_ns, snap.period_ns)
     assert "encode_build" in enc.timings
     _assert_same_profiles(agg, snap, c2, out)
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33, 34, 35])
+def test_encoder_churn_fuzz_multi_window(seed):
+    """Window-sequence fuzz of the churn-tolerant template: random live
+    fractions (patch/append/relocate/rebuild all get exercised), count
+    perturbations, registry growth mid-sequence, and an all-dead pid now
+    and then — every window must parse to exactly the oracle's profiles."""
+    rng = np.random.default_rng(seed)
+    snap_a = generate(_spec(seed=seed, n_pids=8, rows=300))
+    snap_b = generate(_spec(seed=seed + 100, n_pids=14, rows=500))
+    agg = DictAggregator(capacity=1 << 13)
+    enc = WindowEncoder(agg)
+    c_a = np.asarray(agg.window_counts(snap_a))
+    snap, c_full = snap_a, c_a
+    paths_seen: set[str] = set()
+    for w in range(10):
+        if w == 5:
+            # Registry growth: new stacks, new pids, old pids' new locs.
+            c_b = np.asarray(agg.window_counts(snap_b))
+            snap, c_full = snap_b, c_b
+        c = c_full.copy()
+        frac = rng.uniform(0.2, 1.0)
+        c[rng.random(len(c)) < 1 - frac] = 0
+        if rng.random() < 0.5:
+            c[c > 0] += rng.integers(1, 5)
+        if rng.random() < 0.4 and len(np.unique(agg._id_pid[:len(c)])) > 2:
+            # Kill one whole pid this window.
+            victim = int(rng.choice(agg._id_pid[:len(c)]))
+            c[agg._id_pid[:len(c)] == victim] = 0
+        if not int((c > 0).sum()):
+            # All-dead window on a warm template: nothing to ship, and
+            # the stale template must not leak.
+            assert enc.encode(c, snap.time_ns, snap.window_ns,
+                              snap.period_ns) == []
+            continue
+        enc.timings.clear()
+        out = enc.encode(c, snap.time_ns, snap.window_ns, snap.period_ns)
+        paths_seen.add("build" if "encode_build" in enc.timings
+                       else "patch")
+        _assert_same_profiles(agg, snap, c, out)
+    # The fuzz must have exercised the incremental machinery, not routed
+    # every window through the full rebuild.
+    assert "patch" in paths_seen
