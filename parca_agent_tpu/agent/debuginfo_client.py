@@ -84,10 +84,25 @@ def _enc_mark_finished(build_id: str, upload_id: str) -> bytes:
 
 
 class GRPCDebuginfoClient:
-    """DebuginfoManager client over a shared grpc channel."""
+    """DebuginfoManager client over a shared grpc channel.
+
+    `channel` may also be a zero-arg CALLABLE returning the channel:
+    stub construction is then deferred to the first RPC, so a channel
+    whose own construction dials the server (the store client's
+    skip-verify cert fetch) cannot turn agent startup into a crash when
+    the store is transiently down — the manager's per-upload error
+    handling absorbs the raise and retries after its TTL."""
 
     def __init__(self, channel, timeout_s: float = 60.0):
         self._timeout = timeout_s
+        self._should = None
+        if callable(channel):
+            self._channel_provider = channel
+        else:
+            self._channel_provider = lambda: channel
+            self._make_stubs(channel)
+
+    def _make_stubs(self, channel) -> None:
         ident = lambda b: b  # noqa: E731 - raw-bytes (de)serializers
         self._should = channel.unary_unary(
             SHOULD_INITIATE, request_serializer=ident,
@@ -100,12 +115,18 @@ class GRPCDebuginfoClient:
             MARK_FINISHED, request_serializer=ident,
             response_deserializer=ident)
 
+    def _ensure_stubs(self) -> None:
+        if self._should is None:
+            self._make_stubs(self._channel_provider())
+
     def exists(self, build_id: str, hash_: str) -> bool:
+        self._ensure_stubs()
         resp = self._should(_enc_should_initiate(build_id, hash_),
                             timeout=self._timeout)
         return not _dec_should_initiate(resp)
 
     def upload(self, build_id: str, hash_: str, data: bytes) -> None:
+        self._ensure_stubs()
         resp = self._initiate(_enc_initiate(build_id, hash_, len(data)),
                               timeout=self._timeout)
         upload_id = _dec_initiate_upload_id(resp)

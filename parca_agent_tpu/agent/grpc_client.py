@@ -21,51 +21,146 @@ DEBUGINFO_UPLOAD_METHOD = "/parca.debuginfo.v1alpha1.DebuginfoService/Upload"
 MAX_MSG_BYTES = 64 << 20
 
 
+def _fetch_server_cert(address: str) -> tuple[bytes, str]:
+    """(PEM cert, subject common name) of the TLS server at address,
+    fetched WITHOUT verification (the point: the caller asked to skip
+    it). The returned name (subject CN, falling back to the first DNS
+    SAN) lets the caller override SNI/hostname checking against the
+    pinned cert."""
+    import ssl
+    import tempfile
+
+    host, port = _split_host_port(address)
+    pem = ssl.get_server_certificate((host, port))
+    name = ""
+    try:
+        with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
+            f.write(pem)
+            f.flush()
+            decoded = ssl._ssl._test_decode_cert(f.name)  # noqa: SLF001
+        for rdn in decoded.get("subject", ()):
+            for key, value in rdn:
+                if key == "commonName":
+                    name = value
+        if not name:  # SAN-only certs have no CN
+            for kind, value in decoded.get("subjectAltName", ()):
+                if kind == "DNS":
+                    name = value
+                    break
+    except Exception:  # noqa: BLE001 - override is best-effort
+        name = ""
+    return pem.encode(), name
+
+
+def _split_host_port(address: str, default_port: int = 443
+                     ) -> tuple[str, int]:
+    """host:port / bare-host / [v6]:port / bare-[v6] -> (host, port)."""
+    if address.startswith("["):
+        host, _, rest = address[1:].partition("]")
+        return host, int(rest.lstrip(":") or default_port)
+    host, sep, port = address.rpartition(":")
+    if not sep or not port.isdigit():
+        return address, default_port
+    return host, int(port)
+
+
 class GRPCStoreClient:
     def __init__(self, address: str, insecure: bool = False,
+                 insecure_skip_verify: bool = False,
                  bearer_token: str = "", timeout_s: float = 30.0,
                  max_msg_bytes: int = MAX_MSG_BYTES):
         try:
             import grpc
         except ImportError as e:  # pragma: no cover - grpc is in the image
             raise RuntimeError("grpc package unavailable") from e
+        import threading
+
         self._grpc = grpc
         self._timeout = timeout_s
-        options = [
+        self._address = address
+        self._insecure = insecure
+        self._skip_verify = insecure_skip_verify
+        self._token = bearer_token
+        self._options = [
             ("grpc.max_send_message_length", max_msg_bytes),
             ("grpc.max_receive_message_length", max_msg_bytes),
         ]
-        if insecure:
-            self._channel = grpc.insecure_channel(address, options=options)
+        self._bearer = bearer_token if insecure else ""
+        # Channel construction is LAZY (first RPC): grpc channels are
+        # lazy by themselves, but the skip-verify path must dial the
+        # server for its certificate — doing that in __init__ would turn
+        # a transiently unreachable store into an agent startup crash,
+        # where the normal path starts and retries. A failed build is
+        # re-attempted on the next RPC (the batch writer's backoff and
+        # the debuginfo manager's error handling both absorb the raise).
+        self._lock = threading.Lock()
+        self._channel_obj = None
+        self._write_raw_m = None
+
+    def _build_channel(self):
+        grpc = self._grpc
+        options = list(self._options)
+        if self._insecure:
+            return grpc.insecure_channel(self._address, options=options)
+        if self._skip_verify:
+            # The reference's --remote-store-insecure-skip-verify
+            # (InsecureSkipVerify TLS). grpc-python has no direct switch,
+            # so implement the same trust model explicitly: fetch the
+            # server's certificate over an UNVERIFIED handshake and pin
+            # it as the channel's root CA — encrypted transport, no
+            # authentication (trust on first use for the channel's
+            # lifetime). The certificate's own subject/SAN overrides the
+            # hostname check for the same reason. Covers the flag's
+            # dominant case (self-signed server certs); a chain from an
+            # unknown CA still fails — OpenSSL will not treat a
+            # non-self-signed leaf as a trust anchor, and grpc-python
+            # exposes no partial-chain switch.
+            cert, name = _fetch_server_cert(self._address)
+            if name:
+                options.append(("grpc.ssl_target_name_override", name))
+            creds = self._grpc.ssl_channel_credentials(
+                root_certificates=cert)
         else:
             creds = grpc.ssl_channel_credentials()
-            if bearer_token:
-                call_creds = grpc.access_token_call_credentials(bearer_token)
-                creds = grpc.composite_channel_credentials(creds, call_creds)
-            self._channel = grpc.secure_channel(address, creds,
-                                                options=options)
-        self._bearer = bearer_token if insecure else ""
-        # Shared by the debuginfo client (one connection per server, like
-        # the reference's single grpcConn, main.go:595-656).
-        self.channel = self._channel
-        self._write_raw = self._channel.unary_unary(
-            WRITE_RAW_METHOD,
-            request_serializer=lambda b: b,
-            response_deserializer=lambda b: b,
-        )
+        if self._token:
+            call_creds = grpc.access_token_call_credentials(self._token)
+            creds = grpc.composite_channel_credentials(creds, call_creds)
+        return grpc.secure_channel(self._address, creds, options=options)
+
+    @property
+    def channel(self):
+        """Shared by the debuginfo client (one connection per server,
+        like the reference's single grpcConn, main.go:595-656). Built on
+        first access; a failed build raises to the caller and is retried
+        on the next access."""
+        with self._lock:
+            if self._channel_obj is None:
+                self._channel_obj = self._build_channel()
+            return self._channel_obj
 
     def write_raw(self, series: list[RawSeries], normalized: bool) -> None:
+        ch = self.channel
+        if self._write_raw_m is None:
+            self._write_raw_m = ch.unary_unary(
+                WRITE_RAW_METHOD,
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
         metadata = []
         if self._bearer:
             # Insecure channels can't carry call credentials; send the
             # token as plain metadata like the reference's perRequestBearerToken
             # with insecure=true (main.go:620-637).
             metadata.append(("authorization", f"Bearer {self._bearer}"))
-        self._write_raw(
+        self._write_raw_m(
             encode_write_raw_request(series, normalized),
             timeout=self._timeout,
             metadata=metadata or None,
         )
 
     def close(self) -> None:
-        self._channel.close()
+        with self._lock:
+            if self._channel_obj is not None:
+                self._channel_obj.close()
+                self._channel_obj = None
+                self._write_raw_m = None

@@ -179,6 +179,19 @@ class WindowSnapshot:
         return int(self.counts.sum())
 
 
+def filter_snapshot_rows(snap: WindowSnapshot,
+                         mask: np.ndarray) -> WindowSnapshot:
+    """Snapshot restricted to the rows where mask is True (columns are
+    sliced; the mapping table is shared — per-pid lookups for dropped
+    pids simply never happen)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        snap, pids=snap.pids[mask], tids=snap.tids[mask],
+        counts=snap.counts[mask], user_len=snap.user_len[mask],
+        kernel_len=snap.kernel_len[mask], stacks=snap.stacks[mask])
+
+
 def merge_mapping_tables(tables: Sequence[MappingTable]) -> MappingTable:
     """Union several windows' mapping tables into one.
 

@@ -42,6 +42,7 @@ from parca_agent_tpu.capture.formats import (
     STACK_SLOTS,
     MappingTable,
     WindowSnapshot,
+    filter_snapshot_rows,
 )
 from parca_agent_tpu.process.maps import ProcessMapCache, build_mapping_table
 from parca_agent_tpu.process.objectfile import ObjectFileCache
@@ -733,3 +734,67 @@ class PerfEventSampler:
             self._handle = None
         if self._tables is not None:
             self._tables.close()
+
+
+class CommFilterSource:
+    """Snapshot-source wrapper keeping only rows whose pid's comm matches
+    one of the given regexes — the reference's hidden --debug-process-names
+    debug flag (main.go DebugProcessNames: 'Only attach profilers to
+    specified processes', matched against comm). Whole-machine capture
+    stays on; rows are dropped at the window boundary, so the filter
+    composes with any source. Comm verdicts are cached per pid with a
+    TTL: pids get reused by the kernel and processes exec() into new
+    comms, so a verdict is a lease, not a fact (and the TTL also bounds
+    the cache under pid churn).
+
+    NOTE: drains tee'd mid-window (streaming) bypass this filter; the CLI
+    therefore runs debug-filtered sessions one-shot.
+    """
+
+    def __init__(self, source, patterns, read_comm=None,
+                 cache_ttl_s: float = 60.0, clock=time.monotonic):
+        self._source = source
+        self._regexes = [re.compile(p) for p in patterns if p]
+        self._cache: dict[int, tuple[bool, float]] = {}
+        self._ttl = cache_ttl_s
+        self._clock = clock
+
+        def _default_read(pid: int) -> str:
+            try:
+                with open(f"/proc/{pid}/comm", "rb") as f:
+                    return f.read().decode().strip()
+            except OSError:
+                return ""
+
+        self._read_comm = read_comm or _default_read
+
+    def __getattr__(self, name):
+        return getattr(self._source, name)
+
+    def _keep(self, pid: int, now: float) -> bool:
+        got = self._cache.get(pid)
+        if got is not None and now - got[1] < self._ttl:
+            return got[0]
+        comm = self._read_comm(pid)
+        verdict = any(r.search(comm) for r in self._regexes)
+        self._cache[pid] = (verdict, now)
+        return verdict
+
+    def poll(self):
+        snap = self._source.poll()
+        if snap is None or not len(snap) or not self._regexes:
+            return snap
+        now = self._clock()
+        if len(self._cache) > 4 * len(np.unique(snap.pids)) + 1024:
+            # Bound the cache under pid churn: drop expired leases.
+            self._cache = {p: v for p, v in self._cache.items()
+                           if now - v[1] < self._ttl}
+        uniq = np.unique(snap.pids)
+        kept = np.array([p for p in uniq.tolist()
+                         if self._keep(int(p), now)], np.int32)
+        if len(kept) == len(uniq):
+            return snap
+        return filter_snapshot_rows(snap, np.isin(snap.pids, kept))
+
+    def close(self) -> None:
+        self._source.close()

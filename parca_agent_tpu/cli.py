@@ -39,7 +39,42 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remote-store-insecure", action="store_true")
     p.add_argument("--remote-store-batch-write-interval", type=float,
                    default=10.0)
+    p.add_argument("--remote-store-insecure-skip-verify",
+                   action="store_true",
+                   help="skip TLS certificate verification: the server's "
+                        "cert is fetched unverified and pinned for the "
+                        "channel (encrypted, unauthenticated — reference "
+                        "--remote-store-insecure-skip-verify)")
     p.add_argument("--local-store-directory", default="")
+    p.add_argument("--debuginfo-directories", default="/usr/lib/debug",
+                   help="comma-separated local directories searched for "
+                        "separate debuginfo files (reference "
+                        "--debuginfo-directories)")
+    p.add_argument("--debuginfo-strip",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="upload only the sections needed for "
+                        "symbolization; --no-debuginfo-strip ships the "
+                        "exact binary unmodified (reference "
+                        "--debuginfo-strip)")
+    p.add_argument("--debuginfo-upload-cache-duration", type=float,
+                   default=300.0,
+                   help="seconds to cache server-side exists checks "
+                        "(reference --debuginfo-upload-cache-duration, "
+                        "5m)")
+    p.add_argument("--debuginfo-upload-timeout", type=float, default=120.0,
+                   help="per-request debuginfo upload timeout, seconds "
+                        "(reference --debuginfo-upload-timeout-duration, "
+                        "2m)")
+    p.add_argument("--metadata-container-runtime-socket-path", default="",
+                   help="container runtime socket to resolve container "
+                        "pids through, overriding the well-known paths "
+                        "(reference flag of the same name)")
+    p.add_argument("--debug-process-names", default="",
+                   help="DEBUG: comma-separated comm regexes; only "
+                        "matching processes' samples are profiled "
+                        "(reference hidden --debug-process-names). "
+                        "Filtered at the window boundary, so streaming "
+                        "feeds run one-shot")
     p.add_argument("--aggregator", default="cpu",
                    choices=["cpu", "tpu", "dict", "dict+cm", "sharded"],
                    help="window aggregation backend (dict = stateful "
@@ -294,9 +329,11 @@ def run(argv=None) -> int:
         if args.remote_store_bearer_token_file:
             with open(args.remote_store_bearer_token_file) as f:
                 token = f.read().strip()
-        store = GRPCStoreClient(args.remote_store_address,
-                                insecure=args.remote_store_insecure,
-                                bearer_token=token)
+        store = GRPCStoreClient(
+            args.remote_store_address,
+            insecure=args.remote_store_insecure,
+            insecure_skip_verify=args.remote_store_insecure_skip_verify,
+            bearer_token=token)
     else:
         store = NoopStoreClient()
     batch = BatchWriteClient(store,
@@ -330,8 +367,11 @@ def run(argv=None) -> int:
         from parca_agent_tpu.discovery.cri import CRIResolver
         from parca_agent_tpu.discovery.kubernetes import PodDiscoverer
 
-        providers["kubernetes"] = PodDiscoverer(node=args.node or None,
-                                                cri=CRIResolver())
+        providers["kubernetes"] = PodDiscoverer(
+            node=args.node or None,
+            cri=CRIResolver(
+                socket_path=(args.metadata_container_runtime_socket_path
+                             or None)))
     discovery.apply_config(providers)
 
     sd_provider = ServiceDiscoveryProvider()
@@ -357,8 +397,17 @@ def run(argv=None) -> int:
     if not args.debuginfo_upload_disable and args.remote_store_address:
         from parca_agent_tpu.agent.debuginfo_client import GRPCDebuginfoClient
 
+        from parca_agent_tpu.debuginfo.find import Finder
+
+        debug_dirs = tuple(filter(None, (
+            d.strip() for d in args.debuginfo_directories.split(","))))
         debuginfo = DebuginfoManager(
-            client=GRPCDebuginfoClient(store.channel))
+            client=GRPCDebuginfoClient(
+                lambda: store.channel,
+                timeout_s=args.debuginfo_upload_timeout),
+            finder=Finder(debug_dirs=debug_dirs),
+            exists_ttl_s=args.debuginfo_upload_cache_duration,
+            strip=args.debuginfo_strip)
 
     # -- profiler ------------------------------------------------------------
     windows_done = threading.Event()
@@ -390,6 +439,18 @@ def run(argv=None) -> int:
         raise SystemExit(
             "--fast-encode requires --aggregator dict/dict+cm/sharded")
     feeder = None
+    if args.debug_process_names:
+        from parca_agent_tpu.capture.live import CommFilterSource
+
+        patterns = [s.strip() for s in args.debug_process_names.split(",")]
+        source = CommFilterSource(source, patterns)
+        if args.streaming_window:
+            # Mid-window drain tees bypass the boundary filter; the fed
+            # mass would never match the filtered snapshot, so every
+            # window would fall back anyway — be explicit instead.
+            log.warn("--debug-process-names filters at the window "
+                     "boundary; running one-shot (streaming disabled)")
+            args.streaming_window = False
     if args.streaming_window:
         if not (args.fast_encode and hasattr(aggregator, "feed")):
             raise SystemExit("--streaming-window requires --fast-encode "

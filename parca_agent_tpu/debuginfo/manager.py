@@ -62,6 +62,7 @@ class DebuginfoManager:
     def __init__(self, client: DebuginfoClient | None = None,
                  fs: VFS | None = None, finder: Finder | None = None,
                  workers: int = 4, failed_ttl_s: float = 600.0,
+                 exists_ttl_s: float = 300.0, strip: bool = True,
                  clock=None):
         import time as _time
 
@@ -72,7 +73,14 @@ class DebuginfoManager:
                                         thread_name_prefix="debuginfo")
         self._lock = threading.Lock()
         self._uploading: dict[str, object] = {}   # build_id -> Future
-        self._exists: set[str] = set()
+        # Server-confirmed build ids, cached with a TTL (the reference's
+        # --debuginfo-upload-cache-duration, default 5m): servers can
+        # garbage-collect, so "exists" is a lease, not a fact.
+        self._exists: dict[str, float] = {}       # build_id -> confirmed_at
+        self._exists_ttl = exists_ttl_s
+        # strip=False uploads the exact binary unmodified (the
+        # reference's --debuginfo-strip=false).
+        self._strip = strip
         # Failures expire so a transient store outage doesn't blacklist a
         # binary for the agent's lifetime (the reference's caches are
         # TTL-based for the same reason).
@@ -93,7 +101,12 @@ class DebuginfoManager:
                     if self._clock() - failed_at < self._failed_ttl:
                         continue
                     del self._failed[build_id]
-                if build_id in self._exists or build_id in self._uploading:
+                confirmed_at = self._exists.get(build_id)
+                if confirmed_at is not None:
+                    if self._clock() - confirmed_at < self._exists_ttl:
+                        continue
+                    del self._exists[build_id]
+                if build_id in self._uploading:
                     continue
                 fut = self._pool.submit(self._process, pid, path, build_id)
                 self._uploading[build_id] = fut
@@ -127,12 +140,12 @@ class DebuginfoManager:
             h = hashlib.sha256(data).hexdigest()
             if self._client.exists(build_id, h):
                 with self._lock:
-                    self._exists.add(build_id)
+                    self._exists[build_id] = self._clock()
                     self.stats.already_present += 1
                 return
             self._client.upload(build_id, h, data)
             with self._lock:
-                self._exists.add(build_id)
+                self._exists[build_id] = self._clock()
                 self.stats.uploaded += 1
         except Exception as e:
             with self._lock:
@@ -156,6 +169,14 @@ class DebuginfoManager:
                 return payload
             except (OSError, ElfError):
                 pass
+        if not self._strip:
+            # --debuginfo-strip=false: ship the exact binary unmodified
+            # (reference main.go flag semantics).
+            try:
+                ElfFile(raw)
+            except ElfError:
+                return None
+            return raw
         try:
             payload = extract_debuginfo(raw)
             ElfFile(payload)  # validate round-trips

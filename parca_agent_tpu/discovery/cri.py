@@ -240,18 +240,31 @@ class CRIResolver:
 
     def __init__(self, factories: dict[str, "callable"] | None = None,
                  socket_probe: "callable" = None,
-                 breaker_ttl_s: float = 30.0):
+                 breaker_ttl_s: float = 30.0,
+                 socket_path: str | None = None):
         import os
 
         probe = socket_probe or os.path.exists
         if factories is None:
-            factories = {
-                "docker": lambda: DockerClient(),
-                "containerd": lambda: ContainerdClient(
-                    CONTAINERD_SOCKET if probe(CONTAINERD_SOCKET)
-                    else CONTAINERD_K3S_SOCKET),
-                "cri-o": lambda: CrioClient(),
-            }
+            if socket_path:
+                # The reference's
+                # --metadata-container-runtime-socket-path: one
+                # operator-chosen socket for whichever runtime answers
+                # (kubernetes.go passes the same path to every runtime
+                # client it constructs).
+                factories = {
+                    "docker": lambda: DockerClient(socket_path),
+                    "containerd": lambda: ContainerdClient(socket_path),
+                    "cri-o": lambda: CrioClient(socket_path),
+                }
+            else:
+                factories = {
+                    "docker": lambda: DockerClient(),
+                    "containerd": lambda: ContainerdClient(
+                        CONTAINERD_SOCKET if probe(CONTAINERD_SOCKET)
+                        else CONTAINERD_K3S_SOCKET),
+                    "cri-o": lambda: CrioClient(),
+                }
         self._factories = factories
         self._clients: dict[str, object] = {}
         # Per-RUNTIME circuit breaker: one hung socket costs one dial

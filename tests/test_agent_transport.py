@@ -202,3 +202,54 @@ def test_grpc_loopback():
     assert received["series"][0].samples == [b"pp"]
     assert received["normalized"] is True
     assert received["auth"] == "Bearer tok"
+
+
+def test_fetch_server_cert_unverified(tmp_path):
+    """--remote-store-insecure-skip-verify support: the server's cert is
+    fetched over an UNVERIFIED handshake (self-signed — the flag's
+    real-world case) and its common name extracted for the hostname
+    override."""
+    import socket
+    import ssl
+    import subprocess
+
+    from parca_agent_tpu.agent.grpc_client import _fetch_server_cert
+
+    key, crt = tmp_path / "k.pem", tmp_path / "c.pem"
+    r = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(crt), "-days", "1",
+         "-subj", "/CN=selfsigned.test"], capture_output=True)
+    if r.returncode != 0:
+        pytest.skip(f"openssl unavailable: {r.stderr[:100]}")
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(crt), str(key))
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def serve():
+        srv.settimeout(5)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except (TimeoutError, OSError):
+                return  # closed under us at test end: normal shutdown
+            try:
+                with ctx.wrap_socket(conn, server_side=True):
+                    pass
+            except ssl.SSLError:
+                pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        pem, cn = _fetch_server_cert(f"127.0.0.1:{port}")
+        assert b"BEGIN CERTIFICATE" in pem
+        assert cn == "selfsigned.test"
+    finally:
+        stop.set()
+        srv.close()

@@ -326,3 +326,13 @@ def test_pod_discoverer_cri_negative_cache():
     d._cri_failed_until.clear()
     d.scrape()
     assert len(calls) == 2  # TTL expiry retries
+
+
+def test_resolver_socket_path_override_pins_every_runtime():
+    """--metadata-container-runtime-socket-path: one operator-chosen
+    socket for whichever runtime answers, overriding well-known paths."""
+    from parca_agent_tpu.discovery.cri import CRIResolver
+
+    r = CRIResolver(socket_path="/custom/runtime.sock")
+    docker = r._factories["docker"]()
+    assert docker._path == "/custom/runtime.sock"

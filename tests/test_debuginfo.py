@@ -271,3 +271,51 @@ def test_noop_client():
     c = NoopClient()
     assert c.exists("x", "y") is True
     c.upload("x", "y", b"data")
+
+
+def test_manager_exists_cache_expires(binary):
+    """Server-confirmed build ids are a LEASE (reference
+    --debuginfo-upload-cache-duration): after the TTL the exists check
+    re-runs against the server."""
+    bid = gnu_build_id(ElfFile(binary))
+    fs = FakeFS({"/proc/9/root/app/prog": binary})
+    client = RecordingClient(existing=[bid])
+    now = {"t": 1000.0}
+    mgr = DebuginfoManager(client=client, fs=fs, exists_ttl_s=60.0,
+                           clock=lambda: now["t"])
+    mgr.ensure_uploaded([(9, "/app/prog", bid)])
+    mgr.drain()
+    assert mgr.stats.already_present == 1
+    # Inside the TTL: cache hit, no second server round trip.
+    mgr.ensure_uploaded([(9, "/app/prog", bid)])
+    mgr.drain()
+    assert mgr.stats.already_present == 1
+    # Past the TTL: the exists check runs again.
+    now["t"] += 61.0
+    mgr.ensure_uploaded([(9, "/app/prog", bid)])
+    mgr.close()
+    assert mgr.stats.already_present == 2
+
+
+def test_manager_no_strip_uploads_exact_binary(binary):
+    """--no-debuginfo-strip ships the mapped binary unmodified (reference
+    --debuginfo-strip=false semantics)."""
+    bid = gnu_build_id(ElfFile(binary))
+    fs = FakeFS({"/proc/9/root/app/prog": binary})
+    client = RecordingClient()
+    mgr = DebuginfoManager(client=client, fs=fs, strip=False)
+    mgr.ensure_uploaded([(9, "/app/prog", bid)])
+    mgr.close()
+    assert client.uploads == [(bid, len(binary))]   # byte-exact size
+    assert mgr.stats.extracted == 0                 # no extraction ran
+
+
+def test_manager_strip_uploads_smaller_payload(binary):
+    bid = gnu_build_id(ElfFile(binary))
+    fs = FakeFS({"/proc/9/root/app/prog": binary})
+    client = RecordingClient()
+    mgr = DebuginfoManager(client=client, fs=fs, strip=True)
+    mgr.ensure_uploaded([(9, "/app/prog", bid)])
+    mgr.close()
+    assert len(client.uploads) == 1
+    assert client.uploads[0][1] < len(binary)       # actually stripped

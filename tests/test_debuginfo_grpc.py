@@ -81,7 +81,10 @@ def test_grpc_debuginfo_flow_loopback():
     server.start()
     try:
         channel = grpc.insecure_channel(f"127.0.0.1:{port}")
-        client = GRPCDebuginfoClient(channel, timeout_s=10)
+        # Callable form: the production wiring defers channel access to
+        # the first RPC (lazy skip-verify cert fetch must not run at
+        # construction). Stub creation happens here, on exists().
+        client = GRPCDebuginfoClient(lambda: channel, timeout_s=10)
         bid = "ab" * 20
         payload = b"\x7fELF" + bytes(3_000_000)  # multi-chunk
         assert client.exists(bid, "h1") is False

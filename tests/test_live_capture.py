@@ -302,3 +302,59 @@ def test_drain_overflow_is_lossless():
             == len(total)
     finally:
         sampler.close()
+
+
+def test_comm_filter_source_keeps_matching_pids():
+    """--debug-process-names analog: rows whose pid's comm doesn't match
+    are dropped at the window boundary; matching rows are untouched."""
+    import numpy as np
+
+    from parca_agent_tpu.capture.live import CommFilterSource
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+    snap = generate(SyntheticSpec(n_pids=6, n_unique_stacks=120,
+                                  n_rows=120, total_samples=480, seed=3))
+    comms = {int(p): ("keepme" if i % 2 else "other")
+             for i, p in enumerate(np.unique(snap.pids))}
+
+    class Once:
+        def __init__(self):
+            self._left = [snap]
+
+        def poll(self):
+            return self._left.pop() if self._left else None
+
+        def close(self):
+            pass
+
+    src = CommFilterSource(Once(), ["keep"],
+                           read_comm=lambda pid: comms.get(pid, ""))
+    got = src.poll()
+    kept = {p for p, c in comms.items() if c == "keepme"}
+    assert set(np.unique(got.pids).tolist()) == kept
+    # Counts for kept pids are byte-identical to the unfiltered window.
+    for p in kept:
+        assert (got.counts[got.pids == p].sum()
+                == snap.counts[snap.pids == p].sum())
+    assert src.poll() is None
+
+
+def test_comm_filter_source_passthrough_when_all_match():
+    from parca_agent_tpu.capture.live import CommFilterSource
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+    snap = generate(SyntheticSpec(n_pids=3, n_unique_stacks=30,
+                                  n_rows=30, total_samples=90, seed=4))
+
+    class Once:
+        def __init__(self):
+            self._snap = snap
+
+        def poll(self):
+            return self._snap
+
+        def close(self):
+            pass
+
+    src = CommFilterSource(Once(), [".*"], read_comm=lambda pid: "anything")
+    assert src.poll() is snap          # zero-copy passthrough

@@ -485,3 +485,31 @@ def test_cli_sharded_aggregator_replay(tmp_path):
         p = parse_pprof(gzip.decompress(f.read_bytes()))
         tot += sum(v[0] for _, v, _ in p.samples)
     assert tot == snap.total_samples()
+
+
+def test_cli_reference_parity_flags_parse():
+    """Round-5 flag-parity additions parse and land in the expected
+    destinations (reference main.go flags struct)."""
+    from parca_agent_tpu.cli import build_parser
+
+    args = build_parser().parse_args([
+        "--remote-store-insecure-skip-verify",
+        "--debuginfo-directories", "/usr/lib/debug,/opt/debug",
+        "--no-debuginfo-strip",
+        "--debuginfo-upload-cache-duration", "120",
+        "--debuginfo-upload-timeout", "30",
+        "--metadata-container-runtime-socket-path", "/run/x.sock",
+        "--debug-process-names", "nginx.*,redis",
+    ])
+    assert args.remote_store_insecure_skip_verify is True
+    assert args.debuginfo_directories == "/usr/lib/debug,/opt/debug"
+    assert args.debuginfo_strip is False
+    assert args.debuginfo_upload_cache_duration == 120.0
+    assert args.debuginfo_upload_timeout == 30.0
+    assert args.metadata_container_runtime_socket_path == "/run/x.sock"
+    assert args.debug_process_names == "nginx.*,redis"
+    # Defaults mirror the reference's.
+    d = build_parser().parse_args([])
+    assert d.debuginfo_strip is True
+    assert d.debuginfo_upload_cache_duration == 300.0
+    assert d.debuginfo_upload_timeout == 120.0
