@@ -104,15 +104,18 @@ class GRPCDebuginfoClient:
 
     def _make_stubs(self, channel) -> None:
         ident = lambda b: b  # noqa: E731 - raw-bytes (de)serializers
-        self._should = channel.unary_unary(
-            SHOULD_INITIATE, request_serializer=ident,
-            response_deserializer=ident)
+        # self._should doubles as the initialized sentinel for the
+        # manager's concurrent workers: assign it LAST so no thread can
+        # observe a partially-stubbed client.
         self._initiate = channel.unary_unary(
             INITIATE, request_serializer=ident, response_deserializer=ident)
         self._upload = channel.stream_unary(
             UPLOAD, request_serializer=ident, response_deserializer=ident)
         self._mark = channel.unary_unary(
             MARK_FINISHED, request_serializer=ident,
+            response_deserializer=ident)
+        self._should = channel.unary_unary(
+            SHOULD_INITIATE, request_serializer=ident,
             response_deserializer=ident)
 
     def _ensure_stubs(self) -> None:

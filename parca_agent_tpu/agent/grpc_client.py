@@ -21,7 +21,8 @@ DEBUGINFO_UPLOAD_METHOD = "/parca.debuginfo.v1alpha1.DebuginfoService/Upload"
 MAX_MSG_BYTES = 64 << 20
 
 
-def _fetch_server_cert(address: str) -> tuple[bytes, str]:
+def _fetch_server_cert(address: str, timeout_s: float = 30.0
+                       ) -> tuple[bytes, str]:
     """(PEM cert, subject common name) of the TLS server at address,
     fetched WITHOUT verification (the point: the caller asked to skip
     it). The returned name (subject CN, falling back to the first DNS
@@ -31,7 +32,10 @@ def _fetch_server_cert(address: str) -> tuple[bytes, str]:
     import tempfile
 
     host, port = _split_host_port(address)
-    pem = ssl.get_server_certificate((host, port))
+    # Bounded: this fetch runs under the client's channel lock — an
+    # unbounded dial against a black-holed address would hang every
+    # writer and debuginfo worker, not just this call.
+    pem = ssl.get_server_certificate((host, port), timeout=timeout_s)
     name = ""
     try:
         with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
@@ -115,7 +119,8 @@ class GRPCStoreClient:
             # unknown CA still fails — OpenSSL will not treat a
             # non-self-signed leaf as a trust anchor, and grpc-python
             # exposes no partial-chain switch.
-            cert, name = _fetch_server_cert(self._address)
+            cert, name = _fetch_server_cert(self._address,
+                                            timeout_s=self._timeout)
             if name:
                 options.append(("grpc.ssl_target_name_override", name))
             creds = self._grpc.ssl_channel_credentials(

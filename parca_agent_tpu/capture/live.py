@@ -785,11 +785,11 @@ class CommFilterSource:
         if snap is None or not len(snap) or not self._regexes:
             return snap
         now = self._clock()
-        if len(self._cache) > 4 * len(np.unique(snap.pids)) + 1024:
+        uniq = np.unique(snap.pids)
+        if len(self._cache) > 4 * len(uniq) + 1024:
             # Bound the cache under pid churn: drop expired leases.
             self._cache = {p: v for p, v in self._cache.items()
                            if now - v[1] < self._ttl}
-        uniq = np.unique(snap.pids)
         kept = np.array([p for p in uniq.tolist()
                          if self._keep(int(p), now)], np.int32)
         if len(kept) == len(uniq):

@@ -20,14 +20,19 @@ def _pyproject():
         return tomllib.load(f)
 
 
-def test_console_script_target_exists():
+def test_console_script_targets_exist():
     cfg = _pyproject()
-    target = cfg["project"]["scripts"]["parca-agent-tpu"]
-    mod_name, func_name = target.split(":")
     import importlib
 
-    mod = importlib.import_module(mod_name)
-    assert callable(getattr(mod, func_name))
+    scripts = cfg["project"]["scripts"]
+    # Agent binary + the reference's second binary (cmd/eh-frame) + the
+    # pprof inspection tool.
+    assert {"parca-agent-tpu", "parca-agent-tpu-eh-frame",
+            "parca-agent-tpu-pprof-dump"} <= set(scripts)
+    for target in scripts.values():
+        mod_name, func_name = target.split(":")
+        mod = importlib.import_module(mod_name)
+        assert callable(getattr(mod, func_name))
 
 
 def test_version_single_source():
