@@ -502,6 +502,14 @@ def run(argv=None) -> int:
         if hasattr(source, "truncated_drains"):
             out["parca_agent_capture_truncated_drains_total"] = \
                 source.truncated_drains
+        if hasattr(source, "dedup_hits"):
+            # Native drain-side pre-aggregation: hits = samples merged
+            # before Python; overflow = probe-budget exhaustions (emitted
+            # unmerged, correct but unaggregated) — the counter that
+            # makes the published dedup rate monitorable in production.
+            out["parca_agent_capture_dedup_hits_total"] = source.dedup_hits
+            out["parca_agent_capture_dedup_overflow_total"] = \
+                source.dedup_overflow
         labels = ",".join(f'{k}="{v}"'
                           for k, v in binfo.as_metrics().items())
         out[f"parca_agent_build_info{{{labels}}}"] = 1
