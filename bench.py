@@ -587,7 +587,8 @@ def _last_resort(err: str, rows: int, pids: int) -> dict:
     }
 
 
-def _finalize_result(result: dict, device_alive: bool) -> None:
+def _finalize_result(result: dict, device_alive: bool,
+                     probe_log: list | None = None) -> None:
     """Stamp the MECHANICAL scoring fields so no ratio from a fallback
     run can be mistaken for the north-star measurement (the r4 artifact's
     vs_baseline: 159.71 was an honest CPU-backend number at reduced
@@ -602,7 +603,13 @@ def _finalize_result(result: dict, device_alive: bool) -> None:
               — the only combination that counts toward BASELINE.md:23.
       tunnel_down: present (True) when the device probe never succeeded,
               so outage rounds are machine-distinguishable from device
-              rounds that failed in measurement."""
+              rounds that failed in measurement.
+      tunnel_died_mid_run: present (True) only when a probe SUCCEEDED
+              and the later failure is tunnel-shaped (an attempt hang),
+              so a mid-run tunnel death is distinguishable from a plain
+              measurement bug on a healthy tunnel.
+      tunnel_probes: the probe attempts' UTC timestamps/outcomes, when
+              any ran — the artifact's own outage evidence."""
     full = (result.get("rows") or 0) >= (1 << 20) \
         and (result.get("pids") or 0) >= 50_000
     on_device = result.get("backend") not in ("cpu", "numpy-only", None)
@@ -610,6 +617,11 @@ def _finalize_result(result: dict, device_alive: bool) -> None:
     result["scored"] = bool(full and on_device and not result.get("error"))
     if not device_alive:
         result["tunnel_down"] = True
+    elif result.get("error") and "hung" in result["error"] \
+            and any(p.get("outcome") == "ok" for p in probe_log or ()):
+        result["tunnel_died_mid_run"] = True
+    if probe_log:
+        result["tunnel_probes"] = probe_log
 
 
 def _probe_main() -> None:
@@ -740,15 +752,23 @@ def main() -> None:
     probe_timeout = float(os.environ.get("PARCA_BENCH_PROBE_TIMEOUT_S", 420))
     device_alive = ambient_cpu or \
         os.environ.get("PARCA_BENCH_PROBE", "1") == "0"
+    # Outage evidence for the artifact: each probe's UTC timestamp,
+    # outcome, and duration, so a fallback artifact documents WHEN the
+    # tunnel was found dead, mechanically (not just an error string).
+    probe_log: list[dict] = []
     if not device_alive:
         for p_try in (1, 2):
             _progress(f"device probe {p_try} (timeout {probe_timeout:.0f}s)")
             t0 = time.monotonic()
+            at = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
             got = _run_child(probe_timeout, {"PARCA_BENCH_PROBE_CHILD": "1"})
+            took = round(time.monotonic() - t0, 1)
             if isinstance(got, dict) and got.get("probe") == "ok":
                 device_alive = True
+                probe_log.append({"at": at, "outcome": "ok", "s": took})
                 _progress("device probe ok")
                 break
+            probe_log.append({"at": at, "outcome": "dead", "s": took})
             errors.append(f"device probe: {got}" if isinstance(got, str)
                           else f"device probe: unexpected {got}")
             _progress(f"device probe {p_try} failed")
@@ -808,7 +828,7 @@ def main() -> None:
                       "unit": "ms", "vs_baseline": None,
                       "error": (" | ".join(errors)
                                 + f" | last-resort failed: {e2!r}")[:500]}
-    _finalize_result(result, device_alive)
+    _finalize_result(result, device_alive, probe_log)
     print(json.dumps(result))
 
 

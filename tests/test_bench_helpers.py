@@ -166,3 +166,42 @@ def test_finalize_result_scoring_fields():
          "error": "x"}
     bench._finalize_result(r, device_alive=True)
     assert r["scored"] is False
+
+
+def test_finalize_result_outage_escalation():
+    """tunnel_down / tunnel_died_mid_run / tunnel_probes contract: a
+    probe-confirmed-alive tunnel whose attempt HUNG is a mid-run death;
+    a plain measurement bug on a healthy tunnel is neither."""
+    import bench
+
+    ok_probe = [{"at": "2026-07-31T03:16:00Z", "outcome": "ok", "s": 6.8}]
+    dead_probe = [{"at": "2026-07-31T03:39:00Z", "outcome": "dead",
+                   "s": 420.0}]
+
+    # Alive at probe, attempt hung: mid-run death, probes attached.
+    r = {"rows": 1 << 17, "pids": 10_000, "backend": "cpu",
+         "error": "device attempts failed: attempt hung >900s"}
+    bench._finalize_result(r, device_alive=True, probe_log=ok_probe)
+    assert "tunnel_down" not in r
+    assert r["tunnel_died_mid_run"] is True
+    assert r["tunnel_probes"] == ok_probe
+
+    # Alive at probe, NON-hang error (a child bug): no tunnel claim.
+    r = {"rows": 1 << 20, "pids": 50_000, "backend": "tpu",
+         "error": "pprof phase died"}
+    bench._finalize_result(r, device_alive=True, probe_log=ok_probe)
+    assert "tunnel_down" not in r and "tunnel_died_mid_run" not in r
+
+    # Probe skipped (PARCA_BENCH_PROBE=0), attempt hung: no probe
+    # evidence, so no mid-run-death claim either.
+    r = {"rows": 1 << 17, "pids": 10_000, "backend": "cpu",
+         "error": "attempt hung >900s"}
+    bench._finalize_result(r, device_alive=True, probe_log=None)
+    assert "tunnel_died_mid_run" not in r
+
+    # Probe never succeeded: tunnel_down with the probe record.
+    r = {"rows": 1 << 17, "pids": 10_000, "backend": "cpu",
+         "error": "device probe failed"}
+    bench._finalize_result(r, device_alive=False, probe_log=dead_probe)
+    assert r["tunnel_down"] is True
+    assert r["tunnel_probes"] == dead_probe
