@@ -588,7 +588,8 @@ def _last_resort(err: str, rows: int, pids: int) -> dict:
 
 
 def _finalize_result(result: dict, device_alive: bool,
-                     probe_log: list | None = None) -> None:
+                     probe_log: list | None = None,
+                     attempt_hung: bool = False) -> None:
     """Stamp the MECHANICAL scoring fields so no ratio from a fallback
     run can be mistaken for the north-star measurement (the r4 artifact's
     vs_baseline: 159.71 was an honest CPU-backend number at reduced
@@ -605,9 +606,11 @@ def _finalize_result(result: dict, device_alive: bool,
               so outage rounds are machine-distinguishable from device
               rounds that failed in measurement.
       tunnel_died_mid_run: present (True) only when a probe SUCCEEDED
-              and the later failure is tunnel-shaped (an attempt hang),
-              so a mid-run tunnel death is distinguishable from a plain
-              measurement bug on a healthy tunnel.
+              and a device attempt HUNG (attempt_hung is the attempt
+              loop's own structured observation, not a string match on
+              the aggregated error), so a mid-run tunnel death is
+              distinguishable from a plain measurement bug on a healthy
+              tunnel.
       tunnel_probes: the probe attempts' UTC timestamps/outcomes, when
               any ran — the artifact's own outage evidence."""
     full = (result.get("rows") or 0) >= (1 << 20) \
@@ -617,7 +620,7 @@ def _finalize_result(result: dict, device_alive: bool,
     result["scored"] = bool(full and on_device and not result.get("error"))
     if not device_alive:
         result["tunnel_down"] = True
-    elif result.get("error") and "hung" in result["error"] \
+    elif result.get("error") and attempt_hung \
             and any(p.get("outcome") == "ok" for p in probe_log or ()):
         result["tunnel_died_mid_run"] = True
     if probe_log:
@@ -791,6 +794,7 @@ def main() -> None:
     # Attempt 1 (+ one retry on FAST failure — a hang means the backend
     # is wedged and retrying would double the worst case) on the ambient
     # backend.
+    attempt_hung = False
     for attempt in (1, 2) if device_alive else ():
         t0 = time.monotonic()
         _progress(f"device attempt {attempt} (timeout {timeout_s:.0f}s)")
@@ -799,6 +803,8 @@ def main() -> None:
             result = got
             break
         errors.append(got)
+        if got.startswith("attempt hung"):
+            attempt_hung = True  # structured: THIS attempt hung
         _progress(f"device attempt {attempt} failed: {got}")
         if time.monotonic() - t0 > timeout_s / 4:
             break  # slow failure/hang: don't retry
@@ -828,7 +834,7 @@ def main() -> None:
                       "unit": "ms", "vs_baseline": None,
                       "error": (" | ".join(errors)
                                 + f" | last-resort failed: {e2!r}")[:500]}
-    _finalize_result(result, device_alive, probe_log)
+    _finalize_result(result, device_alive, probe_log, attempt_hung)
     print(json.dumps(result))
 
 

@@ -178,25 +178,31 @@ def test_finalize_result_outage_escalation():
     dead_probe = [{"at": "2026-07-31T03:39:00Z", "outcome": "dead",
                    "s": 420.0}]
 
-    # Alive at probe, attempt hung: mid-run death, probes attached.
+    # Alive at probe, attempt hung (structured observation from the
+    # attempt loop): mid-run death, probes attached.
     r = {"rows": 1 << 17, "pids": 10_000, "backend": "cpu",
          "error": "device attempts failed: attempt hung >900s"}
-    bench._finalize_result(r, device_alive=True, probe_log=ok_probe)
+    bench._finalize_result(r, device_alive=True, probe_log=ok_probe,
+                           attempt_hung=True)
     assert "tunnel_down" not in r
     assert r["tunnel_died_mid_run"] is True
     assert r["tunnel_probes"] == ok_probe
 
-    # Alive at probe, NON-hang error (a child bug): no tunnel claim.
+    # Alive at probe, NON-hang attempt failure (a child bug) — even if a
+    # probe hang's text leaked into the aggregated error string, the
+    # structured flag keeps the tunnel unblamed.
     r = {"rows": 1 << 20, "pids": 50_000, "backend": "tpu",
-         "error": "pprof phase died"}
-    bench._finalize_result(r, device_alive=True, probe_log=ok_probe)
+         "error": "device probe: attempt hung >420s | rc=1: child bug"}
+    bench._finalize_result(r, device_alive=True, probe_log=ok_probe,
+                           attempt_hung=False)
     assert "tunnel_down" not in r and "tunnel_died_mid_run" not in r
 
     # Probe skipped (PARCA_BENCH_PROBE=0), attempt hung: no probe
     # evidence, so no mid-run-death claim either.
     r = {"rows": 1 << 17, "pids": 10_000, "backend": "cpu",
          "error": "attempt hung >900s"}
-    bench._finalize_result(r, device_alive=True, probe_log=None)
+    bench._finalize_result(r, device_alive=True, probe_log=None,
+                           attempt_hung=True)
     assert "tunnel_died_mid_run" not in r
 
     # Probe never succeeded: tunnel_down with the probe record.

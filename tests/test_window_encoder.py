@@ -331,16 +331,27 @@ def test_encoder_heavy_churn_rebuilds():
     _assert_same_profiles(agg, snap, c2, out)
 
 
+def _fuzz_agg(kind: str):
+    if kind == "sharded":
+        from parca_agent_tpu.aggregator.sharded import ShardedDictAggregator
+
+        return ShardedDictAggregator(capacity=1 << 13)
+    return DictAggregator(capacity=1 << 13)
+
+
+@pytest.mark.parametrize("agg_kind", ["dict", "sharded"])
 @pytest.mark.parametrize("seed", [31, 32, 33, 34, 35])
-def test_encoder_churn_fuzz_multi_window(seed):
+def test_encoder_churn_fuzz_multi_window(seed, agg_kind):
     """Window-sequence fuzz of the churn-tolerant template: random live
     fractions (patch/append/relocate/rebuild all get exercised), count
     perturbations, registry growth mid-sequence, and an all-dead pid now
-    and then — every window must parse to exactly the oracle's profiles."""
+    and then — every window must parse to exactly the oracle's profiles.
+    Runs over both the single-chip dict and the mesh-sharded variant
+    (same registry mirrors, different placement)."""
     rng = np.random.default_rng(seed)
     snap_a = generate(_spec(seed=seed, n_pids=8, rows=300))
     snap_b = generate(_spec(seed=seed + 100, n_pids=14, rows=500))
-    agg = DictAggregator(capacity=1 << 13)
+    agg = _fuzz_agg(agg_kind)
     enc = WindowEncoder(agg)
     c_a = np.asarray(agg.window_counts(snap_a))
     snap, c_full = snap_a, c_a
