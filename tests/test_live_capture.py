@@ -358,3 +358,37 @@ def test_comm_filter_source_passthrough_when_all_match():
 
     src = CommFilterSource(Once(), [".*"], read_comm=lambda pid: "anything")
     assert src.poll() is snap          # zero-copy passthrough
+
+
+def test_comm_filter_verdict_is_a_lease_not_a_fact():
+    """Kernel pid reuse / exec() comm changes: a cached match verdict
+    expires after the TTL and the comm is re-read."""
+    import numpy as np
+
+    from parca_agent_tpu.capture.live import CommFilterSource
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+
+    snap = generate(SyntheticSpec(n_pids=2, n_unique_stacks=40,
+                                  n_rows=40, total_samples=120, seed=5))
+    pids = sorted(int(p) for p in np.unique(snap.pids))
+    comms = {pids[0]: "keepme", pids[1]: "other"}
+
+    class Repeat:
+        def poll(self):
+            return snap
+
+        def close(self):
+            pass
+
+    now = {"t": 100.0}
+    src = CommFilterSource(Repeat(), ["keep"],
+                           read_comm=lambda pid: comms[pid],
+                           cache_ttl_s=30.0, clock=lambda: now["t"])
+    got = src.poll()
+    assert set(np.unique(got.pids)) == {pids[0]}
+    # The kernel reuses pids[1] for a matching process. Within the TTL
+    # the stale verdict holds; past it, the re-read flips the verdict.
+    comms[pids[1]] = "keepme2"
+    assert set(np.unique(src.poll().pids)) == {pids[0]}
+    now["t"] += 31.0
+    assert set(np.unique(src.poll().pids)) == {pids[0], pids[1]}
