@@ -384,3 +384,26 @@ def test_encoder_churn_fuzz_multi_window(seed, agg_kind):
     # The fuzz must have exercised the incremental machinery, not routed
     # every window through the full rebuild.
     assert "patch" in paths_seen
+
+
+def test_encoder_views_are_invalidated_by_the_next_encode():
+    """views=True returns zero-copy memoryviews into the template buffer,
+    valid only until the next encode() — which patches counts in place.
+    Consumers must finish within their window (the bench does); this pins
+    the aliasing so nobody 'optimizes' the default copy path away."""
+    snap, agg, enc, c_full = _churn_setup(seed=41, n_pids=4, rows=80)
+    out1 = enc.encode(c_full, snap.time_ns, snap.window_ns, snap.period_ns,
+                      views=True)
+    pid0, view0 = out1[0]
+    before = bytes(view0)
+    c2 = c_full.copy()
+    c2[c2 > 0] += 1000            # move every count
+    enc.encode(c2, snap.time_ns, snap.window_ns, snap.period_ns, views=True)
+    after = bytes(view0)
+    assert before != after        # the old view aliases patched memory
+    # The default (views=False) hands out stable copies instead.
+    out3 = enc.encode(c_full, snap.time_ns, snap.window_ns, snap.period_ns)
+    _, blob = out3[0]
+    stable = bytes(blob)
+    enc.encode(c2, snap.time_ns, snap.window_ns, snap.period_ns)
+    assert bytes(blob) == stable
