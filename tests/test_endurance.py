@@ -1,0 +1,33 @@
+"""Opt-in endurance run: many agent windows through the real CLI with the
+synthetic source's worst case (every window is 100% new stacks), so the
+registry grows continuously, dict+cm rotation evicts, and the encoder's
+rebuild threshold trips per window. Run with PARCA_ENDURANCE=1
+(~40 s on a 1-core host); the default suite skips it to stay fast.
+Reference analog: the agent's own long-haul stability expectations
+(iteration failures are non-fatal, pkg/profiler/cpu/cpu.go:326-330)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+
+@pytest.mark.endurance
+def test_agent_survives_many_full_churn_windows(tmp_path):
+    if not os.environ.get("PARCA_ENDURANCE"):
+        pytest.skip("endurance run is opt-in: set PARCA_ENDURANCE=1")
+
+    from parca_agent_tpu.cli import run
+
+    out = tmp_path / "profiles"
+    rc = run(["--capture", "synthetic",
+              "--aggregator", "dict+cm",
+              "--aggregator-capacity", str(1 << 16),
+              "--fast-encode",
+              "--profiling-duration", "0.1", "--windows", "25",
+              "--local-store-directory", str(out),
+              "--http-address", "127.0.0.1:0",
+              "--debuginfo-upload-disable", "--node", "endurance"])
+    assert rc == 0
+    assert len(os.listdir(out)) > 1000
