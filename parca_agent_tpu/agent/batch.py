@@ -47,7 +47,9 @@ class BatchWriteClient:
 
     def write_raw(self, labels: dict[str, str], sample: bytes) -> None:
         """Append one gzipped pprof for a label set (merge by label-set
-        equality, batch_remote_write_client.go:167-184)."""
+        equality, batch_remote_write_client.go:167-184). Lock-protected:
+        the encode pipeline ships from its worker thread while the flush
+        loop drains from its own."""
         s = RawSeries(dict(labels), [sample])
         with self._lock:
             existing = self._buffer.get(s.key())
@@ -55,6 +57,14 @@ class BatchWriteClient:
                 existing.samples.append(sample)
             else:
                 self._buffer[s.key()] = s
+
+    def buffered(self) -> tuple[int, int]:
+        """(series, samples) currently awaiting flush — the observable
+        depth of the encode→ship boundary now that encoding is
+        pipelined ahead of the flush loop."""
+        with self._lock:
+            return (len(self._buffer),
+                    sum(len(s.samples) for s in self._buffer.values()))
 
     def _swap(self) -> list[RawSeries]:
         with self._lock:

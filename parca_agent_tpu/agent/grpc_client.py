@@ -10,6 +10,9 @@ generic unary_unary handle, so the dependency stays import-gated.
 from __future__ import annotations
 
 from parca_agent_tpu.agent.profilestore import RawSeries, encode_write_raw_request
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("grpc")
 
 WRITE_RAW_METHOD = "/parca.profilestore.v1alpha1.ProfileStoreService/WriteRaw"
 DEBUGINFO_UPLOAD_METHOD = "/parca.debuginfo.v1alpha1.DebuginfoService/Upload"
@@ -21,21 +24,34 @@ DEBUGINFO_UPLOAD_METHOD = "/parca.debuginfo.v1alpha1.DebuginfoService/Upload"
 MAX_MSG_BYTES = 64 << 20
 
 
-def _fetch_server_cert(address: str, timeout_s: float = 30.0
-                       ) -> tuple[bytes, str]:
-    """(PEM cert, subject common name) of the TLS server at address,
-    fetched WITHOUT verification (the point: the caller asked to skip
-    it). The returned name (subject CN, falling back to the first DNS
-    SAN) lets the caller override SNI/hostname checking against the
-    pinned cert."""
+def _cert_name_cryptography(pem: str) -> str:
+    """Subject CN (DNS-SAN fallback) via the `cryptography` package.
+    Raises ImportError when the package is absent; any parse failure
+    returns "" so the caller can try the stdlib route."""
+    from cryptography import x509
+    from cryptography.x509.oid import ExtensionOID, NameOID
+
+    try:
+        cert = x509.load_pem_x509_certificate(pem.encode())
+        cns = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+        if cns:
+            return str(cns[0].value)
+        san = cert.extensions.get_extension_for_oid(
+            ExtensionOID.SUBJECT_ALTERNATIVE_NAME)
+        dns = san.value.get_values_for_type(x509.DNSName)
+        return str(dns[0]) if dns else ""
+    except Exception:  # noqa: BLE001 - best-effort, stdlib fallback next
+        return ""
+
+
+def _cert_name_stdlib(pem: str) -> str:
+    """Subject CN (DNS-SAN fallback) via CPython's private
+    ssl._ssl._test_decode_cert — the only stdlib route to the subject of
+    an unverified certificate. Kept as the fallback: the API is private
+    and may vanish, which is why `cryptography` is tried first."""
     import ssl
     import tempfile
 
-    host, port = _split_host_port(address)
-    # Bounded: this fetch runs under the client's channel lock — an
-    # unbounded dial against a black-holed address would hang every
-    # writer and debuginfo worker, not just this call.
-    pem = ssl.get_server_certificate((host, port), timeout=timeout_s)
     name = ""
     try:
         with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
@@ -53,6 +69,44 @@ def _fetch_server_cert(address: str, timeout_s: float = 30.0
                     break
     except Exception:  # noqa: BLE001 - override is best-effort
         name = ""
+    return name
+
+
+def _cert_name(pem: str) -> str:
+    """Best-effort subject name of a PEM certificate for the
+    SNI/hostname override: prefer the supported `cryptography` parser
+    when importable, fall back to the private stdlib decoder."""
+    try:
+        name = _cert_name_cryptography(pem)
+        if name:
+            return name
+    except ImportError:
+        pass
+    return _cert_name_stdlib(pem)
+
+
+def _fetch_server_cert(address: str, timeout_s: float = 30.0
+                       ) -> tuple[bytes, str]:
+    """(PEM cert, subject common name) of the TLS server at address,
+    fetched WITHOUT verification (the point: the caller asked to skip
+    it). The returned name (subject CN, falling back to the first DNS
+    SAN) lets the caller override SNI/hostname checking against the
+    pinned cert."""
+    import ssl
+
+    host, port = _split_host_port(address)
+    # Bounded: this fetch runs under the client's channel lock — an
+    # unbounded dial against a black-holed address would hang every
+    # writer and debuginfo worker, not just this call.
+    pem = ssl.get_server_certificate((host, port), timeout=timeout_s)
+    name = _cert_name(pem)
+    if not name:
+        # Without a derived name the hostname check runs against the
+        # dial address; a CN/SAN mismatch then fails the handshake even
+        # though the cert is pinned — worth a log line, not a crash.
+        _log.warn("could not derive a subject name from the pinned "
+                  "server certificate; skipping the hostname override",
+                  address=address)
     return pem.encode(), name
 
 
@@ -72,7 +126,8 @@ class GRPCStoreClient:
     def __init__(self, address: str, insecure: bool = False,
                  insecure_skip_verify: bool = False,
                  bearer_token: str = "", timeout_s: float = 30.0,
-                 max_msg_bytes: int = MAX_MSG_BYTES):
+                 max_msg_bytes: int = MAX_MSG_BYTES,
+                 reset_after_unavailable: int = 3):
         try:
             import grpc
         except ImportError as e:  # pragma: no cover - grpc is in the image
@@ -100,6 +155,18 @@ class GRPCStoreClient:
         self._lock = threading.Lock()
         self._channel_obj = None
         self._write_raw_m = None
+        # Channel-reset policy (ADVICE round 5): skip-verify pins the
+        # server certificate at first use, so a server cert rotation
+        # makes every internal reconnect fail TLS until the channel is
+        # rebuilt — the reference's InsecureSkipVerify accepts any cert
+        # on every handshake and never gets stuck. Reset the lazy channel
+        # on handshake-class RPC failures, or after N consecutive
+        # UNAVAILABLE errors (how grpc-python surfaces a failed TLS
+        # handshake on reconnect), so the next RPC re-fetches and re-pins
+        # the current certificate.
+        self._reset_after_unavailable = max(1, reset_after_unavailable)
+        self._consec_unavailable = 0
+        self.stats = {"channel_resets": 0}
 
     def _build_channel(self):
         grpc = self._grpc
@@ -157,11 +224,50 @@ class GRPCStoreClient:
             # token as plain metadata like the reference's perRequestBearerToken
             # with insecure=true (main.go:620-637).
             metadata.append(("authorization", f"Bearer {self._bearer}"))
-        self._write_raw_m(
-            encode_write_raw_request(series, normalized),
-            timeout=self._timeout,
-            metadata=metadata or None,
-        )
+        try:
+            self._write_raw_m(
+                encode_write_raw_request(series, normalized),
+                timeout=self._timeout,
+                metadata=metadata or None,
+            )
+        except Exception as e:
+            self._note_rpc_failure(e)
+            raise
+        self._consec_unavailable = 0
+
+    def _note_rpc_failure(self, e: Exception) -> None:
+        """Reset-on-failure bookkeeping (see __init__): a handshake-class
+        error, or reset_after_unavailable consecutive UNAVAILABLEs, drops
+        the built channel so the next RPC re-dials (and, under
+        skip-verify, re-fetches and re-pins the server's current cert).
+        Insecure channels have nothing to re-pin and are left alone."""
+        if self._insecure:
+            return
+        detail = ""
+        for attr in ("details", "debug_error_string"):
+            try:
+                detail += " " + str(getattr(e, attr)() or "")
+            except Exception:  # noqa: BLE001 - non-grpc exceptions
+                pass
+        detail = (detail or repr(e)).lower()
+        handshake = any(s in detail for s in (
+            "handshake", "ssl", "certificate", "authentication"))
+        unavailable = False
+        try:
+            unavailable = e.code() == self._grpc.StatusCode.UNAVAILABLE
+        except Exception:  # noqa: BLE001 - non-grpc exceptions
+            pass
+        if unavailable:
+            self._consec_unavailable += 1
+        if handshake or (unavailable and self._consec_unavailable
+                         >= self._reset_after_unavailable):
+            self._consec_unavailable = 0
+            self.stats["channel_resets"] += 1
+            _log.warn("resetting gRPC channel after RPC failure "
+                      "(re-pinning the server certificate on rebuild)",
+                      address=self._address,
+                      handshake_class=handshake, error=repr(e)[:200])
+            self.close()
 
     def close(self) -> None:
         with self._lock:

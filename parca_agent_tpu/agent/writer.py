@@ -4,6 +4,16 @@ Role of the reference's pkg/profiler/profile_writer.go:32-97:
 FileProfileWriter stores each window's profile as a .pb.gz under a
 directory (--local-store-directory mode); RemoteProfileWriter gzips the
 encoded pprof and hands it to the write path (listener -> batch client).
+
+Thread contract: in fast-encode mode write() is called from the encode
+pipeline's worker thread (ship overlaps the next window's capture), and
+may be called CONCURRENTLY from the profiler thread on the scalar
+fallback path — both writers must (and do) tolerate that:
+FileProfileWriter does one self-contained open/write per profile under a
+nanosecond-stamped filename, RemoteProfileWriter's gzip is pure and its
+downstream batch buffer is lock-protected. `pprof_bytes` may be any bytes-like (the pipeline
+ships zero-copy memoryviews into the encoder's template buffer; the gzip
+pass here materializes them before the view is recycled).
 """
 
 from __future__ import annotations
@@ -31,7 +41,8 @@ class FileProfileWriter:
         with open(path, "wb") as f:
             f.write(sample)
 
-    def write(self, labels: dict[str, str], pprof_bytes: bytes) -> None:
+    def write(self, labels: dict[str, str],
+              pprof_bytes: bytes | memoryview) -> None:
         """Profile-writer interface: encode side handles gzip."""
         self.write_raw(labels, gzip.compress(pprof_bytes, 1))
 
@@ -42,5 +53,6 @@ class RemoteProfileWriter:
     def __init__(self, sink):
         self._sink = sink
 
-    def write(self, labels: dict[str, str], pprof_bytes: bytes) -> None:
+    def write(self, labels: dict[str, str],
+              pprof_bytes: bytes | memoryview) -> None:
         self._sink.write_raw(labels, gzip.compress(pprof_bytes, 1))

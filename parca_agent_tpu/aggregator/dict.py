@@ -247,6 +247,15 @@ class DictAggregator:
         self._ids = np.full(capacity, -1, np.int32)
         self._key_to_id: dict[tuple, int] = {}
         self._next_id = 0
+        # Publication watermark for CONCURRENT READERS (the encode
+        # pipeline's worker thread): _next_id advances per-key inside
+        # _resolve_misses BEFORE the per-id metadata and per-pid
+        # registries are written, so a reader pacing itself by _next_id
+        # could index half-written rows. _published advances only after
+        # _append_id_meta lands the batch (and at rotation), so ids
+        # [0, _published) always have complete, immutable metadata —
+        # the encoder's mirrors sync against this, never _next_id.
+        self._published = 0
         # Per-id metadata, ragged numpy (appended at insertion): stack id i
         # has pid _id_pid[i] and 1-based per-pid loc ids
         # _loc_flat[_loc_off[i]:_loc_off[i+1]] (depth == run length). Flat
@@ -626,6 +635,7 @@ class DictAggregator:
             self._mark_if_unreachable(key, slot, nid)
         self._key_to_id = new_map
         self._next_id = len(kept)
+        self._published = self._next_id
         # Per-pid registries with no surviving stacks go too (memory bound).
         live_pids = set(self._id_pid[: self._next_id].tolist())
         self._pids = {p: r for p, r in self._pids.items() if p in live_pids}
@@ -846,6 +856,9 @@ class DictAggregator:
             grown[:base] = self._loc_flat[:base]
             self._loc_flat = grown
         self._loc_flat[base:need_flat] = flat_vals
+        # Metadata (and the per-pid registries, written by the caller
+        # before this) is complete for every id below need_ids: publish.
+        self._published = need_ids
 
     def _register_stacks_bulk(self, snapshot, rows: np.ndarray) -> None:
         """Vectorized per-pid location registration for a batch of newly

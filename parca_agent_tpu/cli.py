@@ -93,6 +93,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "vectorized template encoder and ship profiles "
                         "unsymbolized (the server symbolizes, as with the "
                         "reference agent); disables local symbolization")
+    p.add_argument("--no-encode-pipeline", action="store_true",
+                   help="disable the background encode pipeline (with "
+                        "--fast-encode the default hands each closed "
+                        "window to a dedicated encoder thread, so capture "
+                        "of window N+1 overlaps encoding/shipping of "
+                        "window N; if the encoder is still busy at the "
+                        "next close, that window ships via the scalar "
+                        "fallback and a backpressure counter increments)")
+    p.add_argument("--encode-deadline", type=float, default=45.0,
+                   help="soft deadline (seconds) for one window's inline "
+                        "pprof encode: past it the encode is abandoned to "
+                        "a daemon thread (it keeps warming the template) "
+                        "and the window ships via the scalar fallback; "
+                        "0 disables. Applies when the encode pipeline is "
+                        "off or has self-disabled")
     p.add_argument("--streaming-window", action="store_true",
                    help="feed each capture drain to the aggregation device "
                         "DURING the window (perf capture + dict aggregator "
@@ -489,6 +504,8 @@ def run(argv=None) -> int:
         window_sink=window_sink,
         fast_encode=args.fast_encode,
         streaming_feeder=feeder,
+        encode_pipeline=args.fast_encode and not args.no_encode_pipeline,
+        encode_deadline_s=args.encode_deadline or None,
     )
 
     # -- HTTP ----------------------------------------------------------------
@@ -513,6 +530,11 @@ def run(argv=None) -> int:
         labels = ",".join(f'{k}="{v}"'
                           for k, v in binfo.as_metrics().items())
         out[f"parca_agent_build_info{{{labels}}}"] = 1
+        if hasattr(store, "stats"):
+            # TOFU re-pin observability: how often the store channel was
+            # reset after handshake-class / repeated-UNAVAILABLE failures.
+            out["parca_agent_remote_store_channel_resets_total"] = \
+                store.stats.get("channel_resets", 0)
         if feeder is not None:
             out["parca_agent_streaming_disabled"] = int(feeder.disabled)
             for k, v in feeder.stats.items():

@@ -35,6 +35,25 @@ differential tests assert sample-for-sample equality.
 
 Labels are NOT embedded per sample: they ride the write request beside the
 profile, exactly as the reference's batch writer carries them.
+
+Thread-ownership contract (the encode pipeline, profiler/encode_pipeline.py):
+
+  * The encoder instance is single-threaded BY SECTION, not by object: a
+    window is split into prepare() — runs on the PROFILER thread at window
+    close, sequenced with every aggregator mutation, and is the only place
+    the id mirrors (_pre_flat/_pre_off/_order) are written — and
+    encode_prepared(), which runs on the ENCODER thread and touches only
+    the template plus the registry rows frozen into the prepared window's
+    caps. The pipeline guarantees prepare() never overlaps encoder-thread
+    work (it parks the worker first).
+  * build_statics() may run on the encoder thread concurrently with the
+    profiler thread FEEDING the next window. That is safe because the
+    aggregator's registries are append-only and published behind a
+    watermark (_published): list reads are bounded by lengths observed
+    under the GIL, id-mirror reads by the watermark, and a rotation
+    observed mid-read at worst caches state that the next prepare() (which
+    always sees the bumped rotation epoch, being sequenced after it)
+    throws away wholesale.
 """
 
 from __future__ import annotations
@@ -225,6 +244,40 @@ class _Template:
         self.period_ns = -1      # period the cached statics embed
 
 
+class _PreparedWindow:
+    """One closed window, frozen on the profiler thread for hand-off to the
+    encoder thread: the live ids/counts (copies — the aggregator's counts
+    buffer is only valid for one close) plus per-pid registry caps
+    (registry object, mapping count, location count) captured while no
+    mutation could be in flight. encode_prepared() reads registries only
+    through these caps, so the next window's inserts can never tear the
+    bytes of this one."""
+
+    __slots__ = ("idx", "vals", "pids_live", "time_ns", "duration_ns",
+                 "period_ns", "rotations", "caps")
+
+    def __init__(self, idx, vals, pids_live, time_ns, duration_ns,
+                 period_ns, rotations, caps):
+        self.idx = idx
+        self.vals = vals
+        self.pids_live = pids_live
+        self.time_ns = time_ns
+        self.duration_ns = duration_ns
+        self.period_ns = period_ns
+        self.rotations = rotations
+        self.caps = caps
+
+
+def _reg_cap(reg) -> tuple:
+    """(registry, safe mapping count, safe location count) for concurrent
+    readers: the loc lists are extended address-first, so the minimum of
+    the three lengths is complete in all of them, and mappings are
+    appended BEFORE any location row references them."""
+    return (reg, len(reg.mappings),
+            min(len(reg.loc_address), len(reg.loc_normalized),
+                len(reg.loc_mapping_id)))
+
+
 _WTAIL_LEN = 22  # [tag][10B time][tag][10B duration], fixed-width
 
 
@@ -267,12 +320,26 @@ class WindowEncoder:
         self._static: dict[int, _PidStatic] = {}
         self._tmpl = _Template()
         self.timings: dict[str, float] = {}
+        # Per-encode observability (ADVICE round 5): the churn-tolerant
+        # template ships dead rows as count-0 samples — legal protobuf,
+        # same profile semantics, but wire bytes the reference never
+        # emits. The fraction makes that bloat monitorable (docs/parity.md
+        # records the deviation).
+        self.stats: dict[str, float | int] = {
+            "windows_encoded": 0,
+            "template_rows": 0,
+            "dead_rows": 0,
+            "dead_row_fraction": 0.0,
+        }
 
     # -- mirrors -------------------------------------------------------------
 
     def _sync(self) -> None:
         """Bring the per-id sample-prefix cache and the pid sort order up to
-        the aggregator's current registry (cheap when nothing changed)."""
+        the aggregator's current registry (cheap when nothing changed).
+        Paces itself by the aggregator's PUBLISHED watermark, not _next_id:
+        a concurrent feed assigns ids before their metadata lands, and the
+        watermark only advances once the rows are complete."""
         agg = self._agg
         rot = agg.stats.get("rotations", 0)
         if rot != self._rotations:
@@ -282,11 +349,26 @@ class WindowEncoder:
             self._pre_off[0] = 0
             self._static.clear()
             self._order = None
-        n = agg._next_id
+        n = getattr(agg, "_published", None)
+        if n is None:
+            n = agg._next_id
         if n > self._synced:
             self._extend_prefixes(self._synced, n)
             self._synced = n
             self._order = None
+
+    def reset(self) -> None:
+        """Drop every mirror, cached static, and the template; the next
+        encode rebuilds from the aggregator's registry. For recovery after
+        an encode aborted mid-flight (encoder-thread exception) left the
+        template state inconsistent."""
+        self._synced = 0
+        self._rotations = -1
+        self._pre_off[0] = 0
+        self._order = None
+        self._order_pid = None
+        self._static.clear()
+        self._tmpl = _Template()
 
     def _ensure_order(self) -> None:
         """Rebuild the id-by-pid sort order if stale. Lazy and separate
@@ -342,17 +424,21 @@ class WindowEncoder:
 
     # -- static sections -----------------------------------------------------
 
-    def _build_head_tail(self, st: _PidStatic, reg, period_ns: int) -> None:
+    def _build_head_tail(self, st: _PidStatic, reg, period_ns: int,
+                         n_mappings: int | None = None) -> None:
         """Rebuild the string-bearing sections (sample_type + mappings +
         string table + period). Location ids/addresses carry no strings, so
         the cached location section survives a mapping change (mapping ids
-        are registry-stable and append-only)."""
+        are registry-stable and append-only). n_mappings bounds the read
+        for encoder-thread callers (a concurrent feed may be appending)."""
+        if n_mappings is None:
+            n_mappings = len(reg.mappings)
         strings = _Strings()
         w = proto.Writer()
         vt = proto.Writer().varint(VT_TYPE, strings("samples")) \
             .varint(VT_UNIT, strings("count"))
         w.message(P_SAMPLE_TYPE, vt.buf)
-        for m in reg.mappings:
+        for m in reg.mappings[:n_mappings]:
             mw = (
                 proto.Writer()
                 .varint(M_ID, m.id)
@@ -372,22 +458,31 @@ class WindowEncoder:
         proto.put_tag_bytes(tail, P_PERIOD_TYPE, bytes(pt.buf))
         proto.put_tag_varint(tail, P_PERIOD, period_ns)
         st.tail = bytes(tail)
-        st.n_mappings = len(reg.mappings)
+        st.n_mappings = n_mappings
         st.period_ns = period_ns
 
-    def _ensure_static(self, pid: int, period_ns: int) -> _PidStatic:
-        agg = self._agg
-        reg = agg._pids[pid]
+    def _ensure_static(self, pid: int, period_ns: int,
+                       cap: tuple | None = None) -> _PidStatic:
+        """Per-pid static sections, built to at least `cap` (registry,
+        n_mappings, n_locs). Without a cap — same-thread callers only —
+        the registry's current lengths are the target. A static built
+        FURTHER than the cap (a prebuild raced ahead) is kept: extra
+        unreferenced locations are legal pprof."""
+        if cap is None:
+            cap = _reg_cap(self._agg._pids[pid])
+        reg, n_mappings, n_locs = cap
         st = self._static.get(pid)
         if st is None:
             st = self._static[pid] = _PidStatic()
-        if st.n_mappings != len(reg.mappings) or st.period_ns != period_ns:
-            self._build_head_tail(st, reg, period_ns)
-        n_locs = len(reg.loc_address)
+        if st.n_mappings < n_mappings or st.period_ns != period_ns:
+            self._build_head_tail(st, reg, period_ns,
+                                  max(n_mappings, st.n_mappings))
         if st.n_locs < n_locs:
             ids = np.arange(st.n_locs + 1, n_locs + 1, dtype=np.uint64)
-            mids = np.asarray(reg.loc_mapping_id[st.n_locs:], np.uint64)
-            addrs = np.asarray(reg.loc_normalized[st.n_locs:], np.uint64)
+            mids = np.asarray(reg.loc_mapping_id[st.n_locs:n_locs],
+                              np.uint64)
+            addrs = np.asarray(reg.loc_normalized[st.n_locs:n_locs],
+                               np.uint64)
             buf, _ = _encode_location_stream(ids, mids, addrs)
             st.loc_bytes.extend(buf.tobytes())
             st.n_locs = n_locs
@@ -458,7 +553,9 @@ class WindowEncoder:
         """Batch head/tail build: Python only interns the (few) mapping
         strings per pid; ALL mapping messages AND all tail sections across
         the batch encode in vectorized passes (the scalar path's
-        per-message Writer varints dominated the 50k-pid first build)."""
+        per-message Writer varints dominated the 50k-pid first build).
+        Items are (static, registry, n_mappings) with the mapping count
+        frozen by the caller (encoder-thread safety)."""
         mid: list[int] = []
         start: list[int] = []
         limit: list[int] = []
@@ -469,11 +566,11 @@ class WindowEncoder:
         tables: list[list[str]] = []
         cpu_i: list[int] = []
         nano_i: list[int] = []
-        for _st, reg in items:
+        for _st, reg, nm in items:
             strings = _Strings()
             strings("samples")
             strings("count")
-            for m in reg.mappings:
+            for m in reg.mappings[:nm]:
                 mid.append(m.id)
                 start.append(m.start)
                 limit.append(m.end)
@@ -492,7 +589,7 @@ class WindowEncoder:
         # Mark pids clean only now, with head AND tail in hand: a raise
         # above (e.g. MemoryError in the stream encode) must leave every
         # staleness guard still tripping so a retry rebuilds fully.
-        for k, (st, reg) in enumerate(items):
+        for k, (st, _reg, nm) in enumerate(items):
             if mid:
                 a, b = int(offs[bounds[k]]), int(offs[bounds[k + 1]])
                 st.head = _SAMPLE_TYPE_SEC + bytes(mv[a:b])
@@ -500,7 +597,7 @@ class WindowEncoder:
                 st.head = _SAMPLE_TYPE_SEC
             st.tail = tails[k]
             st.period_ns = period_ns
-            st.n_mappings = len(reg.mappings)
+            st.n_mappings = nm
 
     def _build_locs_batch(self, dirty) -> None:
         """One vectorized location pass over a batch of (static, registry,
@@ -518,11 +615,11 @@ class WindowEncoder:
             np.arange(total, dtype=np.uint64)
             - np.repeat(bounds[:-1], lens).astype(np.uint64))
         mids = np.fromiter(
-            chain.from_iterable(reg.loc_mapping_id[st.n_locs:]
+            chain.from_iterable(reg.loc_mapping_id[st.n_locs:n]
                                 for st, reg, n in dirty),
             np.uint64, total)
         addrs = np.fromiter(
-            chain.from_iterable(reg.loc_normalized[st.n_locs:]
+            chain.from_iterable(reg.loc_normalized[st.n_locs:n]
                                 for st, reg, n in dirty),
             np.uint64, total)
         buf, offs = _encode_location_stream(ids, mids, addrs)
@@ -533,7 +630,9 @@ class WindowEncoder:
             st.n_locs = n
 
     def build_statics(self, period_ns: int, budget_s: float | None = None,
-                      chunk: int = 4096, loc_chunk: int = 1 << 18) -> int:
+                      chunk: int = 4096, loc_chunk: int = 1 << 18,
+                      caps: dict | None = None, stop=None,
+                      prepare_order: bool = False) -> int:
         """Pre-build known pids' static sections in vectorized location and
         mapping/tail passes (the per-pid _ensure_static path pays a
         vectorization fixed cost per pid — ruinous for the 50k-pid first
@@ -544,37 +643,61 @@ class WindowEncoder:
         pass, whose cost tracks rows not pids) at most `loc_chunk` dirty
         locations per batch — and the call returns between batches once
         the budget is spent, leaving the rest dirty for the next call.
-        This is the amortization hook — the streaming feeder calls it
-        after every drain feed, so by window close the population
-        discovered during the window is already warm and the close-time
-        statics transient is bounded by roughly one batch past the
-        budget, not by the whole window's pid population."""
+        This is the amortization hook — the streaming feeder drives it
+        from its drain tick (directly, or through the encode pipeline's
+        worker thread), so by window close the population discovered
+        during the window is already warm and the close-time statics
+        transient is bounded by roughly one batch past the budget, not by
+        the whole window's pid population.
+
+        caps restricts (and freezes) the build targets to a prepared
+        window's pids: {pid: (registry, n_mappings, n_locs)}; without it
+        every registry pid is targeted at its current published lengths.
+        stop, a threading.Event, aborts between batches regardless of
+        budget — the pipeline sets it to park the worker for a window
+        hand-off."""
         import time as _time
 
         t0 = _time.perf_counter()
         self._sync()
+        if prepare_order:
+            # Pipeline prebuilds run on the WORKER thread: rebuilding the
+            # stale pid sort order here moves the O(n log n) argsort over
+            # the full id space off the window-close hand-off (prepare()
+            # then finds it warm unless ids arrived after the last drain
+            # tick). Inline callers keep the lazy default — on the
+            # polling thread that argsort per drain would be pure loss.
+            self._ensure_order()
         agg = self._agg
+        if caps is not None:
+            targets = [(pid, cap) for pid, cap in caps.items()]
+        else:
+            # list(...) snapshots atomically under the GIL; a pid inserted
+            # by a concurrent feed is simply next call's work.
+            targets = [(pid, _reg_cap(reg))
+                       for pid, reg in list(agg._pids.items())]
         dirty: list[tuple[_PidStatic, object, int]] = []
-        dirty_ht: list[tuple[_PidStatic, object]] = []
-        for pid, reg in agg._pids.items():
+        dirty_ht: list[tuple[_PidStatic, object, int]] = []
+        for pid, (reg, nm, nl) in targets:
             st = self._static.get(pid)
             if st is None:
                 st = self._static[pid] = _PidStatic()
-            if st.n_mappings != len(reg.mappings) \
-                    or st.period_ns != period_ns:
-                dirty_ht.append((st, reg))
-            if st.n_locs < len(reg.loc_address):
-                dirty.append((st, reg, len(reg.loc_address)))
+            if st.n_mappings < nm or st.period_ns != period_ns:
+                dirty_ht.append((st, reg, max(nm, st.n_mappings)))
+            if st.n_locs < nl:
+                dirty.append((st, reg, nl))
         left: set[int] = set()  # ids of statics still dirty in any pass
         did_work = False        # every call makes >=1 chunk of progress
 
         def _spent() -> bool:
+            if stop is not None and stop.is_set():
+                return True
             return (did_work and budget_s is not None
                     and _time.perf_counter() - t0 > budget_s)
 
         for k in range(0, len(dirty_ht), chunk):
             if _spent():
-                left.update(id(st) for st, _ in dirty_ht[k:])
+                left.update(id(st) for st, _, _ in dirty_ht[k:])
                 break
             self._build_head_tail_batch(dirty_ht[k: k + chunk], period_ns)
             did_work = True
@@ -594,12 +717,27 @@ class WindowEncoder:
             self._build_locs_batch(dirty[k: end])
             did_work = True
             k = end
-        return len(agg._pids) - len(left)
+        return len(targets) - len(left)
+
+    def statics_backlog(self, period_ns: int) -> int:
+        """Number of pids whose static sections are still stale (what the
+        next build_statics call would work on) — the amortization driver's
+        progress gauge. Call only from a thread that owns the encoder
+        (same contract as prepare)."""
+        self._sync()
+        n = 0
+        for _pid, reg in list(self._agg._pids.items()):
+            st = self._static.get(_pid)
+            _reg, nm, nl = _reg_cap(reg)
+            if st is None or st.n_mappings < nm \
+                    or st.period_ns != period_ns or st.n_locs < nl:
+                n += 1
+        return n
 
     # -- encode --------------------------------------------------------------
 
     def _build_layout(self, idx: np.ndarray, pids_live: np.ndarray,
-                      period_ns: int) -> None:
+                      period_ns: int, caps: dict | None = None) -> None:
         """Serialize the full window layout (everything except the count and
         time values, which are patched after) and record patch positions.
         Each pid's region is over-allocated with slack so later windows can
@@ -614,8 +752,10 @@ class WindowEncoder:
         # pid, ruinous for a cold 50k-pid first window (the production
         # profiler lands here without ever calling build_statics itself).
         # After this, _ensure_static is a pure cache hit per pid.
-        self.build_statics(period_ns)
-        statics = [self._ensure_static(int(p), period_ns)
+        self.build_statics(period_ns, caps=caps)
+        statics = [self._ensure_static(int(p), period_ns,
+                                       cap=None if caps is None
+                                       else caps.get(int(p)))
                    for p in pids.tolist()]
 
         pre_lens = self._pre_off[idx + 1] - self._pre_off[idx]
@@ -723,7 +863,7 @@ class WindowEncoder:
         return stream, s_off, vp + 2
 
     def _append_rows(self, new_ids: np.ndarray, new_pids: np.ndarray,
-                     period_ns: int) -> None:
+                     period_ns: int, caps: dict | None = None) -> None:
         """Add sample rows for stacks the template has never seen, without
         touching any other pid's bytes: rows (and the location registry's
         append-only delta) go into the owning pid's slack; a pid without
@@ -734,7 +874,7 @@ class WindowEncoder:
         # Batch-build dirty statics first (new stacks usually mean new
         # locations for their pids); the per-pid _ensure_static below is
         # then a cache hit — the same reasoning as _build_layout's.
-        self.build_statics(period_ns)
+        self.build_statics(period_ns, caps=caps)
         stream, s_off, vp_rel = self._serialize_rows(new_ids)
         bounds = np.flatnonzero(np.diff(new_pids)) + 1
         gstarts = np.concatenate(([0], bounds)).tolist()
@@ -747,7 +887,9 @@ class WindowEncoder:
         #                         after the loop, not one np.append each
         for gs, ge in zip(gstarts, gends):
             pid = int(new_pids[gs])
-            st = self._ensure_static(pid, period_ns)
+            st = self._ensure_static(pid, period_ns,
+                                     cap=None if caps is None
+                                     else caps.get(pid))
             g = tmpl.group_of.get(pid)
             lo, hi = int(s_off[gs]), int(s_off[ge])
             if g is not None \
@@ -873,15 +1015,15 @@ class WindowEncoder:
         tmpl.alloc_end = base + cap
         return g, base + vp_rel
 
-    def encode(self, counts: np.ndarray, time_ns: int, duration_ns: int,
-               period_ns: int, views: bool = False) -> list[tuple[int, bytes]]:
-        """Serialize one closed window: per-stack-id counts (as returned by
-        close_window/window_counts) -> [(pid, profile.proto bytes)].
-
-        views=True returns zero-copy memoryviews into the template buffer —
-        valid only until the next encode() call; for callers (bench, batch
-        writer) that consume within the window.
-        """
+    def prepare(self, counts: np.ndarray, time_ns: int, duration_ns: int,
+                period_ns: int) -> _PreparedWindow:
+        """Freeze one closed window for encoding: sync the id mirrors,
+        filter to the live ids (copying them out of the aggregator's
+        one-close counts buffer), and capture per-pid registry caps. Must
+        run on the thread that owns aggregator mutation (the profiler
+        thread) — this is the pipelined hand-off's entire critical
+        section, and the only encoder-state write the profiler thread
+        performs once a pipeline owns the encoder."""
         import time as _time
 
         t0 = _time.perf_counter()
@@ -902,9 +1044,48 @@ class WindowEncoder:
         idx = order[live]
         vals = counts_o[live].astype(np.uint64)
         pids_live = order_pid[live]
+        caps: dict[int, tuple] = {}
+        if len(idx):
+            agg = self._agg
+            for pid in np.unique(pids_live).tolist():
+                reg = agg._pids.get(int(pid))
+                if reg is not None:
+                    caps[int(pid)] = _reg_cap(reg)
         self.timings["encode_sync"] = _time.perf_counter() - t0
+        return _PreparedWindow(idx, vals, pids_live, time_ns, duration_ns,
+                               period_ns, self._rotations, caps)
+
+    def encode(self, counts: np.ndarray, time_ns: int, duration_ns: int,
+               period_ns: int, views: bool = False) -> list[tuple[int, bytes]]:
+        """Serialize one closed window: per-stack-id counts (as returned by
+        close_window/window_counts) -> [(pid, profile.proto bytes)].
+
+        views=True returns zero-copy memoryviews into the template buffer —
+        valid only until the next encode() call; for callers (bench, batch
+        writer) that consume within the window.
+        """
+        return self.encode_prepared(
+            self.prepare(counts, time_ns, duration_ns, period_ns),
+            views=views)
+
+    def encode_prepared(self, prep: _PreparedWindow,
+                        views: bool = False) -> list[tuple[int, bytes]]:
+        """Serialize a prepared window. Runs on the encoder thread under
+        the pipeline; reads aggregator registries only through the caps
+        frozen at prepare time."""
+        import time as _time
+
+        idx, vals, pids_live = prep.idx, prep.vals, prep.pids_live
+        time_ns, duration_ns = prep.time_ns, prep.duration_ns
+        period_ns, caps = prep.period_ns, prep.caps
         if not len(idx):
             return []
+        if prep.rotations != self._rotations:
+            # A registry rotation slid in between prepare and encode; the
+            # prepared ids no longer name these mirrors. The pipeline's
+            # sequencing makes this unreachable — fail loudly if not.
+            raise ValueError("prepared window from a different registry "
+                             "epoch")
         if int(vals.max()) >= 1 << (7 * self._VAL_W):
             raise ValueError("window count exceeds the fixed varint width")
 
@@ -933,7 +1114,7 @@ class WindowEncoder:
                    and n_new <= max(tmpl.n_rows // 2, 1024)
                    and tmpl.waste <= tmpl.alloc_end // 3)
         if not hit:
-            self._build_layout(idx, pids_live, period_ns)
+            self._build_layout(idx, pids_live, period_ns, caps=caps)
             tmpl.period_ns = period_ns
             row = tmpl.row_of[idx]
         else:
@@ -944,7 +1125,8 @@ class WindowEncoder:
                 row = tmpl.row_of[idx]
                 known = row >= 0
             if n_new:
-                self._append_rows(idx[~known], pids_live[~known], period_ns)
+                self._append_rows(idx[~known], pids_live[~known], period_ns,
+                                  caps=caps)
                 row = tmpl.row_of[idx]
         buf = tmpl.buf
         # Patch the per-window values (on a template hit this IS the
@@ -953,6 +1135,14 @@ class WindowEncoder:
         vals_full = np.zeros(tmpl.n_rows, np.uint64)
         vals_full[row] = vals
         put_varints_padded(buf, tmpl.val_pos, vals_full, self._VAL_W)
+        # Dead-row accounting: rows patched to count 0 are wire bytes the
+        # reference never ships (docs/parity.md) — keep the bloat visible.
+        dead = int(tmpl.n_rows - len(row))
+        self.stats["windows_encoded"] += 1
+        self.stats["template_rows"] = int(tmpl.n_rows)
+        self.stats["dead_rows"] = dead
+        self.stats["dead_row_fraction"] = (
+            dead / tmpl.n_rows if tmpl.n_rows else 0.0)
         tp = tmpl.time_pos
         w10 = np.arange(self._TIME_W, dtype=np.int64)
         buf[tp[:, None] + 1 + w10[None, :]] = \

@@ -44,6 +44,13 @@ class ProfilerMetrics:
     last_attempt_duration_s: float = 0.0
     last_symbolize_duration_s: float = 0.0
     last_aggregate_duration_s: float = 0.0
+    # Encode-path observability (fast_encode mode): how long the last
+    # window's pprof encode took (on whichever thread ran it), how many
+    # windows hit the pipeline's backpressure fallback, and how many
+    # inline encodes were abandoned at the soft deadline.
+    last_encode_duration_s: float = 0.0
+    encode_backpressure_total: int = 0
+    encode_deadline_hits_total: int = 0
 
 
 class CPUProfiler:
@@ -66,6 +73,8 @@ class CPUProfiler:
         window_sink: Callable[[WindowSnapshot], None] | None = None,
         fast_encode: bool = False,
         streaming_feeder=None,
+        encode_pipeline: bool = False,
+        encode_deadline_s: float | None = None,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -87,6 +96,31 @@ class CPUProfiler:
             from parca_agent_tpu.pprof.window_encoder import WindowEncoder
 
             self._encoder = WindowEncoder(aggregator)
+        # Encode pipeline: window close hands the aggregated counts to a
+        # dedicated encoder thread, so capture of window N+1 overlaps
+        # encoding/shipping of window N and the encoder's slow transients
+        # (cold statics, post-rotation rebuilds) never stall the capture
+        # loop. Inline soft deadline: without the pipeline, an encode
+        # slower than encode_deadline_s is abandoned to a daemon thread
+        # and the window ships via the scalar fallback.
+        self._pipeline = None
+        if encode_pipeline:
+            if self._encoder is None:
+                raise ValueError("encode_pipeline requires fast_encode")
+            from parca_agent_tpu.profiler.encode_pipeline import (
+                EncodePipeline,
+            )
+
+            self._pipeline = EncodePipeline(self._encoder,
+                                            ship=self._ship_encoded)
+        self._encode_deadline = encode_deadline_s
+        self._encode_inflight = None   # abandoned inline deadline encode
+        self._encode_abandoned = None  # its result box (error inspection)
+        # Writes can come from the profiler thread (inline/scalar paths)
+        # AND the pipeline's worker (shipping window N while window N+1
+        # falls back inline): one lock serializes writer + label lookups
+        # + the written-profiles counter.
+        self._write_mu = threading.Lock()
         # Streaming mode: drains were fed to the device during the window
         # (profiler/streaming.py); close replaces the one-shot aggregate
         # when the feeder confirms it saw the whole window.
@@ -97,18 +131,26 @@ class CPUProfiler:
             # Statics amortization: the feeder prebuilds pprof static
             # sections (budgeted) after each drain feed, so the close-time
             # encode's statics transient is bounded even on a cold first
-            # window at large pid populations.
-            streaming_feeder.attach_encoder(self._encoder)
+            # window at large pid populations. With the pipeline on, the
+            # budgeted build runs on the ENCODER thread (the encoder's
+            # thread-ownership contract); inline it runs on the polling
+            # thread as before.
+            if self._pipeline is not None:
+                streaming_feeder.attach_encoder(
+                    self._encoder, prebuild=self._pipeline.request_prebuild)
+            else:
+                streaming_feeder.attach_encoder(self._encoder)
             # While an abandoned AGGREGATION call (hang watchdog, below)
             # may still be executing inside take_window_if_complete() /
             # window_counts(), it shares registry state the encoder
             # reads; gate the feeder's polling-thread touches on it.
-            # (encode() itself runs on the profiler thread OUTSIDE the
-            # watchdog — host numpy cannot hang on the device — so an
-            # abandoned call can never be inside encode().)
+            # Likewise an inline encode abandoned at its soft deadline
+            # still owns the encoder's mirrors until it returns.
             streaming_feeder.external_blocked = (
-                lambda: self._device_inflight is not None
-                and not self._device_inflight.is_set())
+                lambda: (self._device_inflight is not None
+                         and not self._device_inflight.is_set())
+                or (self._encode_inflight is not None
+                    and not self._encode_inflight.is_set()))
         self._feeder = streaming_feeder
         self._fallback = fallback_aggregator
         self._device_timeout = device_timeout_s
@@ -335,21 +377,62 @@ class CPUProfiler:
             return self._labels.label_set("parca_agent_cpu", pid)
         return {"__name__": "parca_agent_cpu", "pid": str(pid)}
 
-    def _write_profile(self, prof: PidProfile) -> None:
-        labels = self._labels_for(prof.pid)
-        if labels is None:
-            self.process_last_errors[prof.pid] = None
-            return  # relabeling dropped this target
+    def _write_one(self, pid: int, payload) -> bool:
+        """Labels lookup + write + bookkeeping for one profile; False when
+        relabeling dropped the target. `payload` is a zero-arg callable so
+        dropped targets never pay the serialization. Called from the
+        profiler thread (inline/scalar paths) or the pipeline's worker;
+        the write lock covers only the shared mutable state (label-cache
+        lookup, written counter) — serialization/gzip and writer.write
+        run outside it, so a worker-side ship never stalls the capture
+        thread's fallback writes behind a multi-MB gzip (writers tolerate
+        concurrent write(): FileProfileWriter is one open/write per call,
+        RemoteProfileWriter's gzip is pure and its sink buffer locked)."""
         try:
+            with self._write_mu:
+                labels = self._labels_for(pid)
+            if labels is None:
+                self.process_last_errors[pid] = None
+                return False  # relabeling dropped this target
             if self._writer is not None:
-                # compress=False: the writer owns gzip framing (gzipping
-                # here too double-compressed every profile).
-                self._writer.write(labels, build_pprof(prof, compress=False))
-            self.metrics.profiles_written += 1
-            self.process_last_errors[prof.pid] = None
+                self._writer.write(labels, payload())
+            with self._write_mu:
+                self.metrics.profiles_written += 1
+            self.process_last_errors[pid] = None
+            return True
         except Exception as e:
-            self.process_last_errors[prof.pid] = e
+            self.process_last_errors[pid] = e
             raise
+
+    def _write_profile(self, prof: PidProfile) -> None:
+        # compress=False: the writer owns gzip framing (gzipping here too
+        # double-compressed every profile).
+        self._write_one(prof.pid,
+                        lambda: build_pprof(prof, compress=False))
+
+    def _write_encoded(self, out) -> int:
+        """Ship [(pid, blob)] from the fast encoder through the writer."""
+        n = 0
+        for pid, blob in out:
+            if self._write_one(pid, lambda b=blob: b):
+                n += 1
+        return n
+
+    def _ship_encoded(self, out, prep) -> None:
+        """EncodePipeline ship hook (worker thread)."""
+        self._write_encoded(out)
+        if self._pipeline is not None:
+            self.metrics.last_encode_duration_s = \
+                self._pipeline.stats["last_encode_s"]
+
+    def _ship_scalar(self, snapshot: WindowSnapshot) -> int:
+        """Aggregate + write one window through the scalar path (the
+        encode fallback: pipeline backpressure, encoder exceptions, or a
+        blown inline deadline)."""
+        profiles = self._fallback.aggregate(snapshot)
+        for prof in profiles:
+            self._write_profile(prof)
+        return len(profiles)
 
     def _aggregate_encode_write(self, snapshot: WindowSnapshot) -> int:
         """Fast path: counts -> vectorized encoder -> writer, no PidProfile
@@ -383,10 +466,14 @@ class CPUProfiler:
 
         kind, out = self._guarded(fast, fallback)
         if kind == "counts":
+            n_piped = self._submit_to_pipeline(out, snapshot)
+            if n_piped is not None:
+                self.metrics.last_aggregate_duration_s = \
+                    time.perf_counter() - t0
+                self.metrics.samples_aggregated += snapshot.total_samples()
+                return n_piped
             try:
-                out = self._encoder.encode(
-                    out, snapshot.time_ns, snapshot.window_ns,
-                    snapshot.period_ns)
+                out = self._encode_inline(out, snapshot)
                 kind = "enc"
             except Exception as e:  # noqa: BLE001 - window must still ship
                 if self._fallback is None:
@@ -400,22 +487,114 @@ class CPUProfiler:
             for prof in out:
                 self._write_profile(prof)
             return len(out)
-        n = 0
-        for pid, blob in out:
-            labels = self._labels_for(pid)
-            if labels is None:
-                self.process_last_errors[pid] = None
-                continue
-            try:
-                if self._writer is not None:
-                    self._writer.write(labels, blob)
-                self.metrics.profiles_written += 1
-                self.process_last_errors[pid] = None
-                n += 1
-            except Exception as e:
-                self.process_last_errors[pid] = e
-                raise
-        return n
+        return self._write_encoded(out)
+
+    def _submit_to_pipeline(self, counts, snapshot: WindowSnapshot
+                            ) -> int | None:
+        """Try to hand the closed window to the encode pipeline. Returns
+        the handed-off pid count, the scalar-fallback profile count when
+        backpressure forced an inline ship, or None when the window must
+        take the inline encode path (no pipeline / pipeline disabled /
+        backpressure without a fallback aggregator)."""
+        if self._pipeline is None or self._pipeline.disabled:
+            return None
+        fb = None
+        if self._fallback is not None:
+            fb = lambda snap=snapshot: self._ship_scalar(snap)  # noqa: E731
+        try:
+            n = self._pipeline.submit(counts, snapshot.time_ns,
+                                      snapshot.window_ns,
+                                      snapshot.period_ns, fallback=fb)
+        except Exception as e:  # noqa: BLE001 - window must still ship
+            # prepare() died on the profiler thread (e.g. MemoryError
+            # growing mirrors): give this window to the inline path,
+            # whose own try/except still ends in the scalar fallback.
+            _log.warn("pipeline hand-off failed; inline encode for this "
+                      "window", error=repr(e))
+            return None
+        if n is not None:
+            return n
+        # Backpressure: the worker is still encoding the previous window.
+        # The encoder's state is its — this window cannot ride it inline,
+        # so ship through the scalar path (counted, observable).
+        self.metrics.encode_backpressure_total += 1
+        if self._fallback is None:
+            # No scalar path: wait the worker out (bounded), then retry
+            # once — correctness over latency for fallback-less configs.
+            self._pipeline.flush(timeout_s=self._encode_deadline or 60.0)
+            n = self._pipeline.submit(counts, snapshot.time_ns,
+                                      snapshot.window_ns,
+                                      snapshot.period_ns)
+            if n is None:
+                raise RuntimeError(
+                    "encode pipeline busy past its flush bound and no "
+                    "fallback aggregator is configured")
+            return n
+        _log.warn("encode pipeline busy at window close; scalar fallback "
+                  "for this window")
+        return self._ship_scalar(snapshot)
+
+    def _encode_inline(self, counts, snapshot: WindowSnapshot):
+        """Encode on the profiler thread (no pipeline, or pipeline
+        disabled). With encode_deadline_s set, the encode runs on an
+        abandonable daemon thread: a pathological transient (a
+        post-rotation template rebuild is tens of seconds at 50k pids)
+        costs this window a scalar fallback instead of an unbounded
+        capture stall — and the abandoned encode keeps warming the
+        template for the windows after it."""
+        if self._encode_inflight is not None:
+            if not self._encode_inflight.is_set():
+                # The abandoned encode still owns the encoder's state.
+                raise RuntimeError("abandoned encode still in flight")
+            if "err" in (self._encode_abandoned or {}):
+                # The abandoned encode DIED mid-flight: the template may
+                # be half-mutated (same hazard the pipeline's
+                # _fail_window resets for). Drop the mirrors before
+                # touching the encoder again.
+                _log.warn("abandoned encode failed; resetting encoder",
+                          error=repr(self._encode_abandoned["err"]))
+                self._encoder.reset()
+            self._encode_inflight = None
+            self._encode_abandoned = None
+        t0 = time.perf_counter()
+        try:
+            if self._encode_deadline is None:
+                return self._encoder.encode(
+                    counts, snapshot.time_ns, snapshot.window_ns,
+                    snapshot.period_ns)
+            import numpy as np
+
+            # The aggregator's counts buffer is only valid for one close;
+            # an abandoned encode may still be reading after that.
+            counts_copy = np.asarray(counts).copy()
+            box: dict = {}
+            done = threading.Event()
+
+            def call():
+                try:
+                    box["out"] = self._encoder.encode(
+                        counts_copy, snapshot.time_ns, snapshot.window_ns,
+                        snapshot.period_ns)
+                except BaseException as e:  # noqa: BLE001 - surfaced below
+                    box["err"] = e
+                finally:
+                    done.set()
+
+            threading.Thread(target=call, name="encode-deadline",
+                             daemon=True).start()
+            if not done.wait(self._encode_deadline):
+                self._encode_inflight = done
+                self._encode_abandoned = box
+                self.metrics.encode_deadline_hits_total += 1
+                raise RuntimeError(
+                    f"encode exceeded the soft deadline "
+                    f"({self._encode_deadline}s); scalar fallback")
+            if "err" in box:
+                raise box["err"]
+            return box["out"]
+        finally:
+            self.metrics.last_encode_duration_s = \
+                time.perf_counter() - t0
 
     # -- actor --------------------------------------------------------------
 
@@ -434,6 +613,10 @@ class CPUProfiler:
             self.crashed = e
             raise
         finally:
+            if self._pipeline is not None:
+                # Clean shutdown flushes the in-flight window: everything
+                # aggregated gets shipped before the actor exits.
+                self._pipeline.close()
             self._restore_gc()
 
     crashed: BaseException | None = None

@@ -1,0 +1,279 @@
+"""Double-buffered background pprof encode pipeline.
+
+The profiler's window close used to run aggregate -> encode -> ship on one
+thread, so the encoder's slow transients (a ~930 ms cold statics build, a
+~300 ms first template layout, a tens-of-seconds post-rotation rebuild at
+50k pids) stalled the capture loop and risked perf ring-buffer overflow.
+This pipeline moves encode + ship onto a dedicated worker thread:
+
+  * Window close hands the aggregated counts over via submit() — the only
+    profiler-thread work is WindowEncoder.prepare() (mirror sync + live
+    filter + registry caps), a bounded slice of the old inline cost — and
+    capture of window N+1 then overlaps encoding/shipping of window N.
+  * The hand-off queue is two slots deep: the window the worker is
+    encoding plus the shutdown sentinel. There is deliberately NO deeper
+    backlog — a second pending window would need its mirrors synced while
+    the worker still reads them. If the worker is still busy at the next
+    close, submit() refuses (backpressure) and the caller ships that
+    window inline through its scalar fallback, counted and observable.
+  * The streaming feeder's drain-tick statics prebuild is routed here too
+    (request_prebuild), so ALL encoder-state touches outside prepare()
+    happen on the worker thread — the encoder's thread-ownership
+    contract (pprof/window_encoder.py module docs). A prebuild in
+    progress yields at its next budget batch when a hand-off (or
+    shutdown) needs the worker parked.
+  * A worker exception ships the failed window through the caller's
+    fallback, resets the encoder's mirrors, and disables the pipeline —
+    the profiler reverts to its inline path; no window is lost.
+  * close() flushes the in-flight window before stopping the worker, so
+    a draining agent ships everything it aggregated.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("encode-pipeline")
+
+THREAD_NAME = "encode-pipeline"  # self-profile attribution (selfprofile.py)
+
+
+class EncodePipeline:
+    """One worker thread + a two-slot hand-off around a WindowEncoder.
+
+    `ship(out, prep)` is called on the worker thread with the encoded
+    [(pid, blob)] list and the _PreparedWindow; blobs are zero-copy
+    memoryviews into the template buffer (valid until the next encode —
+    i.e. for the whole ship call) unless ship_views=False.
+    """
+
+    def __init__(self, encoder, ship, ship_views: bool = True,
+                 name: str = THREAD_NAME):
+        self._enc = encoder
+        self._ship = ship
+        self._views = ship_views
+        self._name = name
+        self._cond = threading.Condition()
+        self._window = None          # pending (prep, fallback) hand-off
+        self._prebuild = None        # latest coalesced (period_ns, budget_s)
+        self._state = "idle"         # idle | encode | prebuild
+        self._handoff = False        # profiler parked the worker
+        self._interrupt = threading.Event()  # yields a running prebuild
+        self._stopping = False
+        self._thread: threading.Thread | None = None
+        self.disabled = False
+        self.last_error: Exception | None = None
+        self.stats = {
+            "windows_pipelined": 0,
+            "windows_lost": 0,
+            "ship_errors": 0,
+            "backpressure_fallbacks": 0,
+            "prebuilds": 0,
+            "encoder_exceptions": 0,
+            "last_handoff_s": 0.0,
+            "last_encode_s": 0.0,
+            "last_ship_s": 0.0,
+            "overlap_s_total": 0.0,
+        }
+
+    # -- profiler-thread API -------------------------------------------------
+
+    def submit(self, counts, time_ns: int, duration_ns: int, period_ns: int,
+               fallback=None) -> int | None:
+        """Hand one closed window to the worker. Returns the number of
+        live pids handed off, or None when the pipeline is disabled or
+        still busy with the previous window (backpressure — the caller
+        must ship the window itself, normally via its scalar fallback).
+        `fallback`, a zero-arg callable, re-aggregates and ships the
+        window if the worker dies on it. Profiler thread only."""
+        if self.disabled or self._stopping:
+            return None
+        t0 = time.perf_counter()
+        with self._cond:
+            if self._state == "encode" or self._window is not None:
+                self.stats["backpressure_fallbacks"] += 1
+                return None
+            # Park the worker: a budgeted prebuild yields at its next
+            # batch boundary; nothing new starts while _handoff is set.
+            self._handoff = True
+            self._interrupt.set()
+            while self._state != "idle":
+                self._cond.wait()
+        try:
+            prep = self._enc.prepare(counts, time_ns, duration_ns,
+                                     period_ns)
+        except BaseException:
+            with self._cond:
+                self._handoff = False
+                self._interrupt.clear()
+                self._cond.notify_all()
+            raise
+        with self._cond:
+            # Enqueue and unpark in ONE lock acquisition: clearing
+            # _handoff first would let a pending prebuild slip in ahead
+            # of the window (with _interrupt already cleared, nothing
+            # would yield it) and delay the encode by a whole budget.
+            self._window = (prep, fallback)
+            self._handoff = False
+            self._interrupt.clear()
+            self._cond.notify_all()
+        self._ensure_thread()
+        self.stats["last_handoff_s"] = time.perf_counter() - t0
+        return len(prep.caps)
+
+    def request_prebuild(self, period_ns: int,
+                         budget_s: float = 0.25) -> None:
+        """Ask the worker to run one budgeted statics prebuild pass when
+        it is next free (the streaming feeder's drain tick). Coalescing:
+        only the latest request is kept. Never blocks."""
+        if self.disabled or self._stopping or not period_ns:
+            return
+        with self._cond:
+            self._prebuild = (int(period_ns), float(budget_s))
+            self._cond.notify_all()
+        self._ensure_thread()
+
+    @property
+    def busy(self) -> bool:
+        with self._cond:
+            return self._window is not None or self._state == "encode"
+
+    def flush(self, timeout_s: float = 60.0) -> bool:
+        """Block until no window is pending or being encoded (pending
+        prebuilds are not waited for). False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._window is not None or self._state == "encode":
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(left)
+        return True
+
+    def quiesce(self, timeout_s: float = 60.0) -> bool:
+        """flush() plus drain any pending prebuild: the worker is fully
+        parked on return (tests/bench sequencing). False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while (self._window is not None or self._prebuild is not None
+                    or self._state != "idle"):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(left)
+        return True
+
+    def close(self, timeout_s: float = 60.0) -> bool:
+        """Flush the in-flight window, then stop the worker. False if the
+        flush or join timed out."""
+        ok = self.flush(timeout_s)
+        with self._cond:
+            self._stopping = True
+            self._interrupt.set()
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout_s)
+            ok = ok and not t.is_alive()
+        return ok
+
+    # -- worker --------------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._run,
+                                            name=self._name, daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._stopping and self._window is None
+                        and (self._prebuild is None or self._handoff)):
+                    self._cond.wait()
+                if self._window is not None:
+                    job, self._window = ("window", self._window), None
+                    self._state = "encode"
+                elif self._stopping:
+                    return
+                else:
+                    job, self._prebuild = ("prebuild", self._prebuild), None
+                    self._state = "prebuild"
+                self._cond.notify_all()
+            try:
+                if job[0] == "window":
+                    self._do_window(*job[1])
+                else:
+                    period_ns, budget_s = job[1]
+                    self._enc.build_statics(period_ns, budget_s=budget_s,
+                                            stop=self._interrupt,
+                                            prepare_order=True)
+                    self.stats["prebuilds"] += 1
+            except Exception as e:  # noqa: BLE001 - surfaced via disable
+                if job[0] == "window":
+                    self._fail_window(e, job[1][1])
+                    with self._cond:
+                        self._state = "idle"
+                        self._cond.notify_all()
+                    return  # disabled: the worker's work here is done
+                # A prebuild failure is non-fatal: staleness guards still
+                # trip, the next pass (or encode) retries the build.
+                _log.warn("statics prebuild failed on the encode worker",
+                          error=repr(e))
+            finally:
+                with self._cond:
+                    if self._state != "idle":
+                        self._state = "idle"
+                        self._cond.notify_all()
+
+    def _do_window(self, prep, fallback) -> None:
+        t0 = time.perf_counter()
+        out = self._enc.encode_prepared(prep, views=self._views)
+        enc_s = time.perf_counter() - t0
+        self.stats["last_encode_s"] = enc_s
+        self.stats["overlap_s_total"] += enc_s
+        t0 = time.perf_counter()
+        try:
+            self._ship(out, prep)
+        except Exception as e:  # noqa: BLE001 - ship != encoder failure
+            # A writer error is NOT an encoder failure: the template is
+            # healthy, re-shipping via the fallback would duplicate the
+            # profiles already written, and disabling the pipeline over a
+            # transient I/O error would be self-harm. Mirror the inline
+            # path's behavior (a writer raise there loses the rest of the
+            # window as an iteration error): log, count, carry on.
+            self.stats["ship_errors"] += 1
+            _log.warn("pipelined ship failed; window partially shipped",
+                      error=repr(e))
+            return
+        self.stats["last_ship_s"] = time.perf_counter() - t0
+        self.stats["windows_pipelined"] += 1
+
+    def _fail_window(self, e: Exception, fallback) -> None:
+        """Worker died on a window: disable the pipeline (the profiler
+        reverts to its inline path), reset the encoder's possibly
+        half-mutated state, and ship the window via the caller's scalar
+        fallback so it is not lost."""
+        self.stats["encoder_exceptions"] += 1
+        self.last_error = e
+        self.disabled = True
+        _log.warn("encode pipeline failed; disabling and falling back to "
+                  "inline encode", error=repr(e))
+        try:
+            self._enc.reset()
+        except Exception as e2:  # noqa: BLE001 - reset is best-effort
+            _log.warn("encoder reset failed after pipeline error",
+                      error=repr(e2))
+        if fallback is None:
+            self.stats["windows_lost"] += 1
+            _log.warn("no fallback for the failed window; window lost")
+            return
+        try:
+            fallback()
+        except Exception as e2:  # noqa: BLE001 - like an iteration error
+            self.stats["windows_lost"] += 1
+            _log.warn("scalar fallback for the failed window also failed",
+                      error=repr(e2))

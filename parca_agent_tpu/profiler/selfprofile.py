@@ -5,9 +5,13 @@ runtimepprof labels (cmd/parca-agent/main.go:269-275,256): operators
 profile the profiler. Go gets this from its runtime; here the agent
 runtime is Python threads over native/JAX calls, so the self-profiler is
 a sampling wall-clock profiler over `sys._current_frames()` — every
-actor thread (profiler, batch, http, discovery-*) is attributed by its
-thread name via a `thread` sample label, the analog of the reference's
-`component` profile labels.
+actor thread (profiler, batch, http, discovery-*, encode-pipeline) is
+attributed by its thread name via a `thread` sample label, the analog of
+the reference's `component` profile labels. The encode-pipeline worker
+matters here: with pipelined encoding the per-window pprof serialization
+cost moves OFF the profiler thread, and its self-profile attribution is
+how an operator verifies the overlap is real (encode samples under
+`thread=encode-pipeline`, capture samples under `thread=profiler`).
 
 The output is standard gzipped profile.proto with function/line info, so
 any pprof consumer (including this repo's parse_pprof) reads it. Building

@@ -102,6 +102,7 @@ class StreamingWindowFeeder:
         # overlap. external_blocked gates the remaining hazard: an
         # abandoned DEVICE aggregation call that shares registry state.
         self._encoder = None
+        self._prebuild_fn = None
         self._prebuild_period = prebuild_period_ns
         self._prebuild_budget = prebuild_budget_s
         # Optional external gate (the profiler wires its hang-watchdog
@@ -116,9 +117,15 @@ class StreamingWindowFeeder:
                       "windows_fallback": 0, "reprobes": 0,
                       "statics_prebuilt": 0, "last_close_s": 0.0}
 
-    def attach_encoder(self, encoder) -> None:
-        """Wire the profiler's WindowEncoder for statics amortization."""
+    def attach_encoder(self, encoder, prebuild=None) -> None:
+        """Wire the profiler's WindowEncoder for statics amortization.
+        `prebuild(period_ns, budget_s)` overrides WHERE the budgeted
+        build runs: the encode pipeline passes request_prebuild so the
+        drain tick only enqueues and the build lands on the encoder
+        thread (its thread-ownership contract); by default the build
+        runs inline on the polling thread, as before."""
         self._encoder = encoder
+        self._prebuild_fn = prebuild
 
     def device_blocked(self) -> bool:
         """True while an abandoned feed may still be executing inside the
@@ -177,8 +184,13 @@ class StreamingWindowFeeder:
         self.stats["drains_fed"] += 1
         if self._encoder is not None and self._prebuild_period:
             try:
-                self._encoder.build_statics(
-                    self._prebuild_period, budget_s=self._prebuild_budget)
+                if self._prebuild_fn is not None:
+                    self._prebuild_fn(self._prebuild_period,
+                                      self._prebuild_budget)
+                else:
+                    self._encoder.build_statics(
+                        self._prebuild_period,
+                        budget_s=self._prebuild_budget)
                 self.stats["statics_prebuilt"] += 1
             except Exception as e:  # noqa: BLE001 - never fail the tee
                 _log.warn("statics prebuild failed", error=repr(e))

@@ -76,10 +76,37 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None) -> s
              p.metrics.last_symbolize_duration_s, lab)
         emit("parca_agent_profiler_aggregate_duration_seconds",
              p.metrics.last_aggregate_duration_s, lab)
+        emit("parca_agent_profiler_encode_duration_seconds",
+             p.metrics.last_encode_duration_s, lab)
+        emit("parca_agent_profiler_encode_backpressure_total",
+             p.metrics.encode_backpressure_total, lab)
+        emit("parca_agent_profiler_encode_deadline_hits_total",
+             p.metrics.encode_deadline_hits_total, lab)
+        pipe = getattr(p, "_pipeline", None)
+        if pipe is not None:
+            # Encode-pipeline observability: how much encode/ship work ran
+            # off the capture thread (overlap), the hand-off cost that
+            # REMAINED on it, and whether the pipeline is still alive.
+            emit("parca_agent_encode_pipeline_disabled", int(pipe.disabled),
+                 lab)
+            for k, v in pipe.stats.items():
+                emit(f"parca_agent_encode_pipeline_{k}",
+                     round(v, 6) if isinstance(v, float) else v, lab)
+        enc = getattr(p, "_encoder", None)
+        if enc is not None and getattr(enc, "stats", None):
+            # Template dead rows: count-0 samples shipped (wire-size
+            # deviation from the reference — docs/parity.md).
+            for k, v in enc.stats.items():
+                emit(f"parca_agent_encoder_{k}",
+                     round(v, 6) if isinstance(v, float) else v, lab)
     if batch_client is not None:
         emit("parca_agent_remote_write_batches_sent_total",
              batch_client.sent_batches)
         emit("parca_agent_remote_write_errors_total", batch_client.send_errors)
+        if hasattr(batch_client, "buffered"):
+            series, samples = batch_client.buffered()
+            emit("parca_agent_remote_write_buffered_series", series)
+            emit("parca_agent_remote_write_buffered_samples", samples)
     for k, v in (extra or {}).items():
         emit(k, v)
     return "\n".join(lines) + "\n"

@@ -253,3 +253,158 @@ def test_fetch_server_cert_unverified(tmp_path):
     finally:
         stop.set()
         srv.close()
+
+
+# -- channel reset on RPC failure (TOFU re-pin, ADVICE round 5) --------------
+
+
+def _reset_client(monkeypatch, builds, fail_with, skip_verify=True):
+    """GRPCStoreClient against a fake channel whose WriteRaw always raises
+    fail_with(); counts channel builds."""
+    grpc = pytest.importorskip("grpc")
+    from parca_agent_tpu.agent.grpc_client import GRPCStoreClient
+
+    class FakeChannel:
+        def unary_unary(self, *a, **kw):
+            def call(req, timeout=None, metadata=None):
+                raise fail_with()
+            return call
+
+        def close(self):
+            pass
+
+    client = GRPCStoreClient("store.test:443",
+                             insecure_skip_verify=skip_verify,
+                             reset_after_unavailable=3)
+    monkeypatch.setattr(
+        client, "_build_channel",
+        lambda: builds.append(1) or FakeChannel())
+    return grpc, client
+
+
+class _FakeRpcError(Exception):
+    def __init__(self, code, details=""):
+        self._code, self._details = code, details
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+    def debug_error_string(self):
+        return self._details
+
+
+def test_handshake_failure_resets_channel_for_repin(monkeypatch):
+    """A handshake-class RPC failure drops the built channel, so the next
+    RPC re-dials and (under skip-verify) re-fetches + re-pins the server's
+    CURRENT cert — a server cert rotation no longer bricks shipping until
+    agent restart."""
+    builds: list = []
+    grpc, client = _reset_client(
+        monkeypatch, builds,
+        lambda: _FakeRpcError(grpc_code_unavailable(),
+                              "Ssl handshake failed: CERTIFICATE_VERIFY"))
+    with pytest.raises(Exception):
+        client.write_raw([RawSeries({"a": "1"}, [b"x"])], normalized=True)
+    assert len(builds) == 1
+    assert client.stats["channel_resets"] == 1
+    with pytest.raises(Exception):
+        client.write_raw([RawSeries({"a": "1"}, [b"x"])], normalized=True)
+    assert len(builds) == 2          # channel was rebuilt (re-pin point)
+
+
+def grpc_code_unavailable():
+    import grpc
+
+    return grpc.StatusCode.UNAVAILABLE
+
+
+def test_consecutive_unavailable_resets_channel(monkeypatch):
+    """N consecutive UNAVAILABLEs (how grpc-python surfaces reconnect TLS
+    failures) also reset; a success clears the streak."""
+    builds: list = []
+    grpc, client = _reset_client(
+        monkeypatch, builds,
+        lambda: _FakeRpcError(grpc_code_unavailable(), "connection refused"))
+    for k in range(3):
+        with pytest.raises(Exception):
+            client.write_raw([RawSeries({"a": "1"}, [b"x"])],
+                             normalized=True)
+    assert client.stats["channel_resets"] == 1   # on the 3rd, not before
+    assert len(builds) == 1
+    with pytest.raises(Exception):
+        client.write_raw([RawSeries({"a": "1"}, [b"x"])], normalized=True)
+    assert len(builds) == 2
+
+
+def test_non_tls_errors_do_not_reset(monkeypatch):
+    """A data-plane failure (e.g. RESOURCE_EXHAUSTED) keeps the channel:
+    resets are for trust/transport rot, not payload problems."""
+    grpc = pytest.importorskip("grpc")
+    builds: list = []
+    _, client = _reset_client(
+        monkeypatch, builds,
+        lambda: _FakeRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              "message too large"))
+    for _ in range(5):
+        with pytest.raises(Exception):
+            client.write_raw([RawSeries({"a": "1"}, [b"x"])],
+                             normalized=True)
+    assert client.stats["channel_resets"] == 0
+    assert len(builds) == 1
+
+
+def test_insecure_channel_never_resets(monkeypatch):
+    grpc = pytest.importorskip("grpc")
+    from parca_agent_tpu.agent.grpc_client import GRPCStoreClient
+
+    client = GRPCStoreClient("store.test:80", insecure=True)
+    for _ in range(5):
+        client._note_rpc_failure(
+            _FakeRpcError(grpc.StatusCode.UNAVAILABLE, "handshake ssl"))
+    assert client.stats["channel_resets"] == 0
+
+
+def test_cert_name_prefers_cryptography_with_stdlib_fallback(tmp_path):
+    """_cert_name: the `cryptography` route is tried first when
+    importable; the private-API stdlib decoder stays as fallback and both
+    agree on a real self-signed cert."""
+    import subprocess
+
+    from parca_agent_tpu.agent import grpc_client as gc
+
+    key, crt = tmp_path / "k.pem", tmp_path / "c.pem"
+    r = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(crt), "-days", "1",
+         "-subj", "/CN=rotated.test"], capture_output=True)
+    if r.returncode != 0:
+        pytest.skip(f"openssl unavailable: {r.stderr[:100]}")
+    pem = crt.read_text()
+    assert gc._cert_name_stdlib(pem) == "rotated.test"
+    assert gc._cert_name(pem) == "rotated.test"
+    try:
+        import cryptography  # noqa: F401
+    except ImportError:
+        pass
+    else:
+        assert gc._cert_name_cryptography(pem) == "rotated.test"
+
+
+def test_cert_name_unparseable_is_empty_and_logged():
+    from parca_agent_tpu.agent import grpc_client as gc
+
+    assert gc._cert_name("not a pem") == ""
+
+
+def test_batch_buffered_depth_gauge():
+    c = BatchWriteClient(NoopStoreClient(), interval_s=60)
+    assert c.buffered() == (0, 0)
+    c.write_raw({"pid": "1"}, b"a")
+    c.write_raw({"pid": "1"}, b"b")
+    c.write_raw({"pid": "2"}, b"c")
+    assert c.buffered() == (2, 3)
+    c.flush()
+    assert c.buffered() == (0, 0)
