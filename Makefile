@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-live bench fixtures golden clean install
+.PHONY: all native test test-live chaos bench fixtures golden clean install
 
 all: native
 
@@ -21,6 +21,12 @@ test:
 # root, Makefile:204-205).
 test-live:
 	$(PYTHON) -m pytest tests/ -q -m live
+
+# Fault-injection suite under a fixed seed (docs/robustness.md): store
+# outages, disk-full spill, actor crashes — deterministic by design, so
+# it also rides every unmarked run.
+chaos:
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py -q -m chaos
 
 # The driver-scored benchmark: ONE JSON line on stdout.
 bench:

@@ -575,6 +575,21 @@ def run(emit=None) -> dict:
             extras["sync_error"] = repr(e)[:200]
         _emit_partial()
 
+    # Ship-path outage soak (docs/robustness.md): the batch->spool->replay
+    # runtime under a scripted 60 s store outage at bench scale, in
+    # SIMULATED time (host-side only — no device, so it can neither hang
+    # the attempt nor disturb the headline). Reports the robustness
+    # acceptance numbers: bytes_dropped, spill depth, replay lag, and
+    # supervisor actor restarts, all deterministic under the fixed seed.
+    if os.environ.get("PARCA_BENCH_SOAK", "1") != "0" \
+            and _budget_left(0.1, "ship_soak"):
+        try:
+            extras["ship_soak"] = _ship_soak()
+            _progress(f"ship soak done: {extras['ship_soak']}")
+        except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+            extras["ship_soak_error"] = repr(e)[:200]
+        _emit_partial()
+
     # Exact-vs-count-min A/B at the full unique-stack scale (BASELINE
     # config #4): the sketch is the bounded-memory degradation mode
     # (DictAggregator overflow="sketch"); publish its error envelope
@@ -659,6 +674,121 @@ def run(emit=None) -> dict:
             extras["batch_kernel_error"] = repr(e)[:120]
 
     return {**result, **extras}
+
+
+def _ship_soak() -> dict:
+    """Outage soak of the ship runtime (bounded batch buffer + disk spool
+    + jittered budgeted retry + replay): 180 simulated seconds of window
+    traffic with the store UNAVAILABLE from t=10 to t=70, driven through
+    the same fault-injection layer the chaos suite uses. Window payloads
+    are real gzipped-pprof-sized blobs; everything runs on a simulated
+    clock so the phase costs milliseconds of wall time. A parallel
+    real-time supervisor run (injected actor crashes) contributes the
+    actor_restarts number."""
+    import gzip
+    import random
+    import shutil
+    import threading
+
+    from parca_agent_tpu.agent.batch import BatchWriteClient
+    from parca_agent_tpu.agent.spool import SpoolDir
+    from parca_agent_tpu.runtime.supervisor import Supervisor
+    from parca_agent_tpu.utils.faults import FaultInjector
+
+    clk = [0.0]
+
+    def clock():
+        return clk[0]
+
+    def sleep(s):
+        clk[0] += s
+
+    inj = FaultInjector.from_spec(
+        "store.write_raw:unavailable:after=10,for=60",
+        seed=42, clock=clock, sleep=sleep)
+    spool_dir = tempfile.mkdtemp(prefix="parca_soak_spool_")
+    delivered = {"n": 0, "bytes": 0}
+
+    class Store:
+        def write_raw(self, series, normalized):
+            inj.check("store.write_raw")
+            for s in series:
+                delivered["n"] += len(s.samples)
+                delivered["bytes"] += sum(len(b) for b in s.samples)
+
+    buffer_cap = 32 << 20
+    spool_cap = 256 << 20
+    sp = SpoolDir(spool_dir, max_bytes=spool_cap, clock=clock)
+    c = BatchWriteClient(Store(), interval_s=10.0, clock=clock, sleep=sleep,
+                         rng=random.Random(42), initial_backoff_s=0.01,
+                         max_buffer_bytes=buffer_cap, retry_budget=4,
+                         spill_after_failures=1, spool=sp,
+                         replay_per_interval=3)
+    # Bench-scale window payload: ~50 profiles/window of gzipped pprof.
+    rng = np.random.default_rng(42)
+    payload = gzip.compress(rng.integers(0, 255, 60_000,
+                                         np.uint8).tobytes(), 1)
+    written = 0
+    rss_max = 0
+    spill_depth_max = 0
+    replay_lag_max = 0.0
+    try:
+        for t in range(180):
+            clk[0] = float(t)
+            for pid in range(5):
+                c.write_raw({"pid": str(pid), "t": str(t)}, payload)
+                written += 1
+            if t % 10 == 9:
+                c.flush()
+            rss_max = max(rss_max, c.buffer_bytes() + sp.pending()[1])
+            spill_depth_max = max(spill_depth_max, sp.pending()[0])
+            replay_lag_max = max(replay_lag_max, sp.oldest_age_s())
+        t_drain = 180.0
+        while (sp.pending()[0] or c.buffered()[1]) and t_drain < 400:
+            clk[0] = t_drain
+            c.flush()
+            t_drain += 10.0
+    finally:
+        shutil.rmtree(spool_dir, ignore_errors=True)
+
+    # Supervisor leg (real time, milliseconds): an injected double crash
+    # of a flush actor must be absorbed by restarts.
+    crash_inj = FaultInjector.from_spec("soak.actor:crash:count=2", seed=42)
+    done = threading.Event()
+
+    def actor():
+        while not done.is_set():
+            crash_inj.check("soak.actor")
+            done.wait(0.005)
+
+    sup = Supervisor(max_restarts=5, backoff_initial_s=0.005,
+                     backoff_max_s=0.01, healthy_after_s=0.05)
+    sup.add_actor("flush", run=actor, stop=done.set)
+    sup.start()
+    deadline = time.monotonic() + 10
+    while sup.health()["flush"]["restarts"] < 2 \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    restarts = sup.health()["flush"]["restarts"]
+    survived = sup.health()["flush"]["state"] != "dead"
+    sup.stop()
+
+    return {
+        "outage_s": 60,
+        "windows_written": written,
+        "windows_delivered": delivered["n"],
+        "samples_lost": written - delivered["n"],
+        "bytes_dropped": (c.stats["bytes_dropped"]
+                          + sp.stats["bytes_dropped"]),
+        "spill_depth_max_segments": spill_depth_max,
+        "replay_lag_s": round(replay_lag_max, 1),
+        "rss_proxy_max_bytes": rss_max,
+        "rss_cap_bytes": buffer_cap + spool_cap,
+        "under_cap": rss_max <= buffer_cap + spool_cap,
+        "segments_replayed": c.stats["segments_replayed"],
+        "actor_restarts": restarts,
+        "actor_survived": survived,
+    }
 
 
 def _last_resort(err: str, rows: int, pids: int) -> dict:

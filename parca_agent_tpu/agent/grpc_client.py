@@ -10,6 +10,7 @@ generic unary_unary handle, so the dependency stays import-gated.
 from __future__ import annotations
 
 from parca_agent_tpu.agent.profilestore import RawSeries, encode_write_raw_request
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
 _log = get_logger("grpc")
@@ -117,7 +118,11 @@ def _split_host_port(address: str, default_port: int = 443
         host, _, rest = address[1:].partition("]")
         return host, int(rest.lstrip(":") or default_port)
     host, sep, port = address.rpartition(":")
-    if not sep or not port.isdigit():
+    if not sep:
+        return address, default_port
+    if port == "":
+        return host, default_port  # trailing colon: "host:"
+    if not port.isdigit():
         return address, default_port
     return host, int(port)
 
@@ -165,12 +170,18 @@ class GRPCStoreClient:
         # handshake on reconnect), so the next RPC re-fetches and re-pins
         # the current certificate.
         self._reset_after_unavailable = max(1, reset_after_unavailable)
+        # Failure bookkeeping is mutated from the writer's flush thread
+        # AND the debuginfo workers; its own lock (not the channel lock:
+        # _note_rpc_failure calls close(), which takes the channel lock —
+        # sharing one would deadlock).
+        self._stats_lock = threading.Lock()
         self._consec_unavailable = 0
         self.stats = {"channel_resets": 0}
 
     def _build_channel(self):
         grpc = self._grpc
         options = list(self._options)
+        faults.inject("grpc.handshake")
         if self._insecure:
             return grpc.insecure_channel(self._address, options=options)
         if self._skip_verify:
@@ -210,14 +221,24 @@ class GRPCStoreClient:
                 self._channel_obj = self._build_channel()
             return self._channel_obj
 
+    def _write_raw_method(self):
+        """The WriteRaw callable, built (with its channel) under the
+        channel lock and returned as a LOCAL reference: a concurrent
+        close()/reset can null the cached attribute at any time, so
+        callers must never read it twice."""
+        with self._lock:
+            if self._channel_obj is None:
+                self._channel_obj = self._build_channel()
+            if self._write_raw_m is None:
+                self._write_raw_m = self._channel_obj.unary_unary(
+                    WRITE_RAW_METHOD,
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b,
+                )
+            return self._write_raw_m
+
     def write_raw(self, series: list[RawSeries], normalized: bool) -> None:
-        ch = self.channel
-        if self._write_raw_m is None:
-            self._write_raw_m = ch.unary_unary(
-                WRITE_RAW_METHOD,
-                request_serializer=lambda b: b,
-                response_deserializer=lambda b: b,
-            )
+        method = self._write_raw_method()
         metadata = []
         if self._bearer:
             # Insecure channels can't carry call credentials; send the
@@ -225,7 +246,11 @@ class GRPCStoreClient:
             # with insecure=true (main.go:620-637).
             metadata.append(("authorization", f"Bearer {self._bearer}"))
         try:
-            self._write_raw_m(
+            # The chaos site sits inside the failure classifier's scope so
+            # an injected UNAVAILABLE/handshake drives the same reset
+            # bookkeeping a real RPC failure would.
+            faults.inject("grpc.write_raw")
+            method(
                 encode_write_raw_request(series, normalized),
                 timeout=self._timeout,
                 metadata=metadata or None,
@@ -233,7 +258,8 @@ class GRPCStoreClient:
         except Exception as e:
             self._note_rpc_failure(e)
             raise
-        self._consec_unavailable = 0
+        with self._stats_lock:
+            self._consec_unavailable = 0
 
     def _note_rpc_failure(self, e: Exception) -> None:
         """Reset-on-failure bookkeeping (see __init__): a handshake-class
@@ -257,12 +283,18 @@ class GRPCStoreClient:
             unavailable = e.code() == self._grpc.StatusCode.UNAVAILABLE
         except Exception:  # noqa: BLE001 - non-grpc exceptions
             pass
-        if unavailable:
-            self._consec_unavailable += 1
-        if handshake or (unavailable and self._consec_unavailable
-                         >= self._reset_after_unavailable):
-            self._consec_unavailable = 0
-            self.stats["channel_resets"] += 1
+        # Decide-and-count under the stats lock: writer + debuginfo
+        # threads race through here, and an unguarded read-modify-write
+        # both loses counts and can double-reset the channel.
+        with self._stats_lock:
+            if unavailable:
+                self._consec_unavailable += 1
+            reset = handshake or (unavailable and self._consec_unavailable
+                                  >= self._reset_after_unavailable)
+            if reset:
+                self._consec_unavailable = 0
+                self.stats["channel_resets"] += 1
+        if reset:
             _log.warn("resetting gRPC channel after RPC failure "
                       "(re-pinning the server certificate on rebuild)",
                       address=self._address,

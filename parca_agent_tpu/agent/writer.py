@@ -22,6 +22,9 @@ import gzip
 import os
 import time
 
+from parca_agent_tpu.utils import faults
+from parca_agent_tpu.utils.vfs import atomic_write_bytes
+
 
 def _series_filename(labels: dict[str, str], now_ns: int) -> str:
     parts = [f"{k}={labels[k]}" for k in sorted(labels)
@@ -36,10 +39,13 @@ class FileProfileWriter:
         os.makedirs(directory, exist_ok=True)
 
     def write_raw(self, labels: dict[str, str], sample: bytes) -> None:
-        """`sample` is already a gzipped pprof proto."""
+        """`sample` is already a gzipped pprof proto. Written through a
+        tmp file + os.replace so a crash (or injected disk-full) mid-write
+        never leaves a truncated .pb.gz in the local-store directory —
+        readers of the directory only ever see whole profiles."""
         path = os.path.join(self._dir, _series_filename(labels, time.time_ns()))
-        with open(path, "wb") as f:
-            f.write(sample)
+        faults.inject("writer.write")
+        atomic_write_bytes(path, sample)
 
     def write(self, labels: dict[str, str],
               pprof_bytes: bytes | memoryview) -> None:

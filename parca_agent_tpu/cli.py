@@ -39,6 +39,39 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remote-store-insecure", action="store_true")
     p.add_argument("--remote-store-batch-write-interval", type=float,
                    default=10.0)
+    p.add_argument("--remote-store-batch-buffer-bytes", type=int,
+                   default=64 << 20,
+                   help="in-memory batch buffer byte cap; past it the "
+                        "buffered batch spills to --spool-directory (or "
+                        "is dropped, counted) — deviation from the "
+                        "reference's unbounded retry-forever buffer "
+                        "(docs/robustness.md)")
+    p.add_argument("--remote-store-batch-buffer-samples", type=int,
+                   default=100_000,
+                   help="in-memory batch buffer sample-count cap")
+    p.add_argument("--remote-store-retry-budget", type=int, default=8,
+                   help="send retries per flush interval, SHARED between "
+                        "the live flush and spool replay (full-jitter "
+                        "exponential backoff between attempts)")
+    p.add_argument("--spool-directory", default="",
+                   help="directory for disk spill of batches the store "
+                        "could not take (outage write-ahead spool); "
+                        "empty disables spill (overflow then drops, "
+                        "counted)")
+    p.add_argument("--spool-max-bytes", type=int, default=256 << 20,
+                   help="spool byte cap; past it the OLDEST segments are "
+                        "evicted (counted drops)")
+    p.add_argument("--spool-replay-per-interval", type=int, default=4,
+                   help="max spilled segments replayed per flush interval "
+                        "after the store recovers (bounded-rate catch-up)")
+    p.add_argument("--fault-inject", default="",
+                   help="CHAOS: semicolon-separated fault rules "
+                        "(site:kind[:k=v,...], utils/faults.py) injected "
+                        "at named ship-path sites; also read from the "
+                        "PARCA_FAULTS env var. Testing only")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for the fault injector's probability draws "
+                        "(PARCA_FAULT_SEED env var)")
     p.add_argument("--remote-store-insecure-skip-verify",
                    action="store_true",
                    help="skip TLS certificate verification: the server's "
@@ -201,6 +234,19 @@ def run(argv=None) -> int:
     log.info("starting parca-agent-tpu", version=binfo.display(),
              python=binfo.python)
 
+    # -- fault injection (chaos testing) ------------------------------------
+    import os as _os
+
+    from parca_agent_tpu.utils import faults as faults_mod
+
+    fault_spec = args.fault_inject or _os.environ.get("PARCA_FAULTS", "")
+    if fault_spec:
+        seed = args.fault_seed or int(
+            _os.environ.get("PARCA_FAULT_SEED", "0"))
+        faults_mod.install(
+            faults_mod.FaultInjector.from_spec(fault_spec, seed=seed))
+        log.warn("fault injection ACTIVE", spec=fault_spec, seed=seed)
+
     # Fleet join must precede ANY jax backend touch (device probing in
     # the aggregators below would pin a single-process backend).
     if args.fleet_coordinator:
@@ -351,8 +397,20 @@ def run(argv=None) -> int:
             bearer_token=token)
     else:
         store = NoopStoreClient()
-    batch = BatchWriteClient(store,
-                             interval_s=args.remote_store_batch_write_interval)
+    spool = None
+    if args.spool_directory:
+        from parca_agent_tpu.agent.spool import SpoolDir
+
+        spool = SpoolDir(args.spool_directory,
+                         max_bytes=args.spool_max_bytes)
+    batch = BatchWriteClient(
+        store,
+        interval_s=args.remote_store_batch_write_interval,
+        max_buffer_bytes=args.remote_store_batch_buffer_bytes,
+        max_buffer_samples=args.remote_store_batch_buffer_samples,
+        retry_budget=args.remote_store_retry_budget,
+        spool=spool,
+        replay_per_interval=args.spool_replay_per_interval)
     listener = MatchingProfileListener(next_writer=batch)
     if args.local_store_directory:
         file_writer = FileProfileWriter(args.local_store_directory)
@@ -508,6 +566,15 @@ def run(argv=None) -> int:
         encode_deadline_s=args.encode_deadline or None,
     )
 
+    # -- supervision ---------------------------------------------------------
+    # The reference's oklog/run group tears the process down when any
+    # actor exits; an always-on profiler instead restarts crashed actors
+    # with capped backoff and reports per-actor state on /healthz
+    # (docs/robustness.md).
+    from parca_agent_tpu.runtime.supervisor import Supervisor
+
+    sup = Supervisor()
+
     # -- HTTP ----------------------------------------------------------------
     def capture_metrics():
         """Capture-loss observability (VERDICT r1 weak #5): ring LOST
@@ -581,7 +648,8 @@ def run(argv=None) -> int:
                            profilers=[profiler], batch_client=batch,
                            listener=listener, version=binfo.display(),
                            extra_metrics=capture_metrics,
-                           capture_info=capture_metrics)
+                           capture_info=capture_metrics,
+                           supervisor=sup)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None
@@ -591,20 +659,27 @@ def run(argv=None) -> int:
             [lambda cfg: labels_mgr.apply_config(cfg.relabel_configs)],
         )
 
-    # -- run group (reference oklog/run, main.go:505-592) --------------------
-    threads = [threading.Thread(target=batch.run, name="batch", daemon=True)]
+    # -- run group (supervised; reference oklog/run, main.go:505-592) --------
+    sup.add_actor("flush", run=batch.run, stop=batch.stop)
     if reloader:
-        threads.append(threading.Thread(target=reloader.run, name="reload",
-                                        daemon=True))
-    profiler_thread = threading.Thread(target=profiler.run, name="profiler",
-                                       daemon=True)
-    threads.append(profiler_thread)
+        sup.add_actor("reload", run=reloader.run, stop=reloader.stop,
+                      critical=False)
+    sup.add_actor("profiler", run=profiler.run, stop=profiler.stop)
 
     stop = threading.Event()
     if fleet_merger is not None:
-        threads.append(threading.Thread(
-            target=lambda: fleet_merger.run(stop), name="fleet",
-            daemon=True))
+        sup.add_actor("fleet", run=lambda: fleet_merger.run(stop),
+                      stop=stop.set, critical=False)
+    if profiler._pipeline is not None:
+        # The encode pipeline owns its worker thread; supervise it as a
+        # probe — a worker death disables the pipeline, the probe revives
+        # it (bounded by the crash budget).
+        pipe = profiler._pipeline
+        sup.add_probe("encode", check=lambda: not pipe.disabled,
+                      revive=pipe.revive, critical=False)
+    if providers:
+        sup.add_probe("discovery", check=discovery.alive,
+                      revive=discovery.restart_dead, critical=False)
 
     def shutdown(*_a):
         stop.set()
@@ -621,26 +696,24 @@ def run(argv=None) -> int:
         discovery.wait_for_update(0, timeout=2.0)
         sd_provider.update(discovery.groups())
     http.start()
-    for t in threads:
-        t.start()
+    sup.start()
     log.info("parca-agent-tpu listening", address=args.http_address,
              aggregator=args.aggregator, capture=args.capture)
 
     try:
-        while not stop.is_set() and profiler_thread.is_alive() \
+        while not stop.is_set() and not sup.finished("profiler") \
                 and not windows_done.is_set():
             stop.wait(0.2)
     finally:
-        profiler.stop()
-        if reloader:
-            reloader.stop()
-        batch.stop()
+        sup.stop()
         discovery.stop()
-        for t in threads:
-            t.join(timeout=5)
         if debuginfo is not None:
             debuginfo.close()
         http.stop()
+    if sup.health().get("profiler", {}).get("state") == "dead":
+        log.error("profiler actor dead (crash budget exhausted)",
+                  exc=profiler.crashed)
+        return 1
     if profiler.crashed is not None:
         log.error("profiler crashed", exc=profiler.crashed)
         return 1

@@ -52,17 +52,42 @@ class DiscoveryManager:
 
     def run(self) -> None:
         for name, p in self._providers.items():
-            t = threading.Thread(
-                target=self._run_provider, args=(name, p),
-                name=f"discovery-{name}", daemon=True,
-            )
-            t.start()
-            self._threads.append(t)
+            self._spawn(name, p)
+
+    def _spawn(self, name: str, p: Discoverer) -> None:
+        t = threading.Thread(
+            target=self._run_provider, args=(name, p),
+            name=f"discovery-{name}", daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
+
+    # -- supervision hooks (runtime/supervisor.py probe actor) ---------------
+
+    def alive(self) -> bool:
+        """True while every started provider thread is still running (a
+        provider that raised died silently before; the supervisor's probe
+        surfaces and heals it)."""
+        return all(t.is_alive() for t in self._threads)
+
+    def restart_dead(self) -> int:
+        """Respawn provider threads that died (the supervisor's revive
+        hook). Returns how many were restarted."""
+        if self._stop.is_set():
+            return 0
+        dead = [t for t in self._threads if not t.is_alive()]
+        for t in dead:
+            self._threads.remove(t)
+            name = t.name.removeprefix("discovery-")
+            p = self._providers.get(name)
+            if p is not None:
+                self._spawn(name, p)
+        return len(dead)
 
     def _run_provider(self, name: str, p: Discoverer) -> None:
         def up(groups: list[Group]) -> None:

@@ -23,6 +23,7 @@ from typing import Callable, Protocol
 from parca_agent_tpu.aggregator.base import Aggregator, PidProfile
 from parca_agent_tpu.capture.formats import WindowSnapshot
 from parca_agent_tpu.pprof.builder import build_pprof
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
 _log = get_logger("profiler")
@@ -359,7 +360,10 @@ class CPUProfiler:
             return
         import gc
 
-        if window == 1:
+        if not self._gc_modified:
+            # First managed window of THIS run (not of the process): a
+            # supervised restart re-enters run() after the crash path
+            # restored the default collector, and must re-arm here.
             gc.collect()
             gc.freeze()
             gc.disable()
@@ -599,9 +603,14 @@ class CPUProfiler:
     # -- actor --------------------------------------------------------------
 
     def run(self) -> None:
+        # Re-runnable under supervision: a crashed profiler actor is
+        # restarted by the run group, so a successful re-entry clears the
+        # previous crash record.
+        self.crashed = None
         try:
             while not self._stop.is_set():
                 t0 = time.monotonic()
+                faults.inject("actor.profiler")
                 if not self.run_iteration():
                     return
                 elapsed = time.monotonic() - t0
@@ -609,11 +618,18 @@ class CPUProfiler:
         except BaseException as e:
             # Anything escaping run_iteration is a bug, not an iteration
             # failure; record it so the CLI can exit nonzero instead of
-            # treating thread death as a clean shutdown.
+            # treating thread death as a clean shutdown (and so the
+            # supervisor can decide to restart this actor).
             self.crashed = e
             raise
         finally:
-            if self._pipeline is not None:
+            # The pipeline is torn down only on a real exit (stop
+            # requested or source exhausted): a supervised restart after
+            # a crash must find it alive, not stopped. GC stewardship is
+            # ALWAYS restored — the process may outlive a crashed,
+            # unsupervised profiler, and must not inherit a disabled
+            # collector; a supervised re-entry re-arms it in _manage_gc.
+            if self.crashed is None and self._pipeline is not None:
                 # Clean shutdown flushes the in-flight window: everything
                 # aggregated gets shipped before the actor exits.
                 self._pipeline.close()

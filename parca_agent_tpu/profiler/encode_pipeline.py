@@ -34,6 +34,7 @@ from __future__ import annotations
 import threading
 import time
 
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
 _log = get_logger("encode-pipeline")
@@ -229,8 +230,28 @@ class EncodePipeline:
                         self._state = "idle"
                         self._cond.notify_all()
 
+    def revive(self, reset: bool = False) -> None:
+        """Re-arm a pipeline disabled by a worker death (the supervisor's
+        probe-revive hook): clear the disabled latch so the next submit()
+        restarts the worker thread. The encoder's mirrors were already
+        reset by _fail_window; ``reset=True`` forces another reset for
+        callers reviving after external encoder surgery."""
+        if reset:
+            try:
+                self._enc.reset()
+            except Exception as e:  # noqa: BLE001 - best-effort
+                _log.warn("encoder reset failed during revive",
+                          error=repr(e))
+        self.disabled = False
+        self.last_error = None
+        _log.info("encode pipeline revived")
+
     def _do_window(self, prep, fallback) -> None:
         t0 = time.perf_counter()
+        # Chaos site: an injected crash here is a worker death — the
+        # window ships via the caller's fallback, the pipeline disables,
+        # and the supervisor's probe revives it.
+        faults.inject("actor.encode")
         out = self._enc.encode_prepared(prep, views=self._views)
         enc_s = time.perf_counter() - t0
         self.stats["last_encode_s"] = enc_s

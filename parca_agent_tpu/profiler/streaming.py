@@ -48,6 +48,7 @@ from parca_agent_tpu.capture.live import (
     columns_to_snapshot,
     mapping_table_for_pids,
 )
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
 _log = get_logger("streaming")
@@ -127,6 +128,17 @@ class StreamingWindowFeeder:
         self._encoder = encoder
         self._prebuild_fn = prebuild
 
+    def _enter_cooldown(self, why: str) -> None:
+        """Disable feeding for a capped-exponential number of windows
+        (the single degradation path for feed failures, hangs, and
+        injected crashes alike — chaos must degrade exactly like real
+        trouble)."""
+        self.disabled = True
+        self._cooldown = self._backoff
+        self._backoff = min(self._backoff * 2, self._backoff_max)
+        _log.warn(why + "; one-shot window aggregation for the next "
+                  "windows", cooldown_windows=self._cooldown)
+
     def device_blocked(self) -> bool:
         """True while an abandoned feed may still be executing inside the
         aggregator (nothing else may touch it until then)."""
@@ -143,6 +155,14 @@ class StreamingWindowFeeder:
         if self.disabled:
             return
         if self.external_blocked is not None and self.external_blocked():
+            return
+        try:
+            # Chaos site: the drain tick runs synchronously inside the
+            # sampler's poll(), so an injected crash must degrade (the
+            # feeder's own cooldown path), never escape into capture.
+            faults.inject("actor.feeder")
+        except Exception:  # noqa: BLE001 - injected crash -> cooldown
+            self._enter_cooldown("injected feeder crash")
             return
         import numpy as np
 
@@ -173,12 +193,7 @@ class StreamingWindowFeeder:
             # Do NOT try again this window: a wedged device would stall
             # the capture loop on every subsequent drain. Re-probe only
             # at a window boundary, after a capped-exponential cooldown.
-            self.disabled = True
-            self._cooldown = self._backoff
-            self._backoff = min(self._backoff * 2, self._backoff_max)
-            _log.warn("streaming feed failed; one-shot window "
-                      "aggregation for the next windows",
-                      cooldown_windows=self._cooldown)
+            self._enter_cooldown("streaming feed failed")
             return
         self._fed_total += mini.total_samples()
         self.stats["drains_fed"] += 1

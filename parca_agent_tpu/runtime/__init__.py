@@ -1,0 +1,1 @@
+"""Actor runtime: supervised run-groups for the always-on agent."""

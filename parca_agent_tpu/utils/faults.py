@@ -1,0 +1,230 @@
+"""Deterministic fault injection for the ship path.
+
+The always-on agent's hard scenarios — hours-long store outages, cert
+rotations, disk-full spool directories, partial actor death — cannot be
+waited for; they have to be injected. This module is the single chaos
+layer the ship-path components consult at NAMED SITES:
+
+    grpc.write_raw    the WriteRaw RPC (unavailable / handshake / latency)
+    grpc.handshake    channel construction (TLS handshake class)
+    spool.write       spill-segment write (disk_full)
+    writer.write      local-store profile write (disk_full)
+    batch.flush       one flush attempt of the batch client
+    actor.<name>      a supervised actor's loop tick (crash)
+
+Sites call :func:`inject` which is a no-op until an injector is installed
+(via the CLI's --fault-inject flag, the PARCA_FAULTS env var, or a test):
+production pays one module-attribute read per site.
+
+Determinism: every probabilistic draw comes from one seeded
+``random.Random`` and every time window from one injectable clock, so a
+fixed seed + deterministic call order reproduces the same fault schedule
+— the chaos suite and the bench soak phase both rely on this.
+
+Rule spec grammar (CLI/env), semicolon-separated::
+
+    site:kind[:k=v[,k=v...]]
+
+    kinds:  unavailable | handshake | error | latency | disk_full | crash
+    keys:   p=<prob 0..1>   firing probability (default 1)
+            after=<s>       rule arms this many seconds after install
+            for=<s>         rule disarms this many seconds after arming
+            count=<n>       max total firings
+            ms=<millis>     latency kinds: injected delay
+
+Example — a scripted 60 s store outage five seconds in, plus a flaky
+spool disk::
+
+    grpc.write_raw:unavailable:after=5,for=60;spool.write:disk_full:p=0.2
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import random
+import threading
+import time
+
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("faults")
+
+
+class InjectedFault(Exception):
+    """Base class for every injected failure (tests filter on it)."""
+
+
+class InjectedCrash(InjectedFault):
+    """An actor-crash fault: escapes the actor's loop so the supervisor
+    sees a real thread death."""
+
+
+class InjectedRpcError(InjectedFault):
+    """Mimics a grpc RpcError closely enough for GRPCStoreClient's
+    failure classifier: code() returns the real StatusCode.UNAVAILABLE
+    when grpc is importable, and the detail string carries the handshake
+    markers for handshake-class rules."""
+
+    def __init__(self, kind: str, site: str):
+        self.kind = kind
+        detail = (f"injected fault at {site}: Ssl handshake failed"
+                  if kind == "handshake"
+                  else f"injected fault at {site}: connection refused")
+        super().__init__(detail)
+        self._detail = detail
+
+    def code(self):
+        try:
+            import grpc
+
+            return grpc.StatusCode.UNAVAILABLE
+        except ImportError:  # pragma: no cover - grpc is in the image
+            return "UNAVAILABLE"
+
+    def details(self) -> str:
+        return self._detail
+
+    def debug_error_string(self) -> str:
+        return self._detail
+
+
+def injected_disk_full(site: str) -> OSError:
+    return OSError(errno.ENOSPC,
+                   f"injected fault at {site}: no space left on device")
+
+
+@dataclasses.dataclass
+class FaultRule:
+    site: str              # exact name, or prefix wildcard "actor.*"
+    kind: str              # unavailable|handshake|error|latency|disk_full|crash
+    p: float = 1.0
+    after_s: float = 0.0
+    for_s: float | None = None
+    count: int | None = None
+    latency_s: float = 0.0
+    fired: int = 0
+
+    def matches(self, site: str) -> bool:
+        if self.site.endswith("*"):
+            return site.startswith(self.site[:-1])
+        return site == self.site
+
+
+_KINDS = ("unavailable", "handshake", "error", "latency", "disk_full",
+          "crash")
+
+
+def parse_rules(spec: str) -> list[FaultRule]:
+    rules = []
+    for part in filter(None, (s.strip() for s in spec.split(";"))):
+        fields = part.split(":", 2)
+        if len(fields) < 2:
+            raise ValueError(f"bad fault rule {part!r} (want site:kind)")
+        site, kind = fields[0], fields[1]
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(want one of {_KINDS})")
+        rule = FaultRule(site=site, kind=kind)
+        for kv in filter(None, (fields[2].split(",")
+                                if len(fields) == 3 else ())):
+            k, _, v = kv.partition("=")
+            if k == "p":
+                rule.p = float(v)
+            elif k == "after":
+                rule.after_s = float(v)
+            elif k == "for":
+                rule.for_s = float(v)
+            elif k == "count":
+                rule.count = int(v)
+            elif k == "ms":
+                rule.latency_s = float(v) / 1e3
+            else:
+                raise ValueError(f"unknown fault rule key {k!r} in {part!r}")
+        rules.append(rule)
+    return rules
+
+
+class FaultInjector:
+    def __init__(self, rules: list[FaultRule], seed: int = 0,
+                 clock=time.monotonic, sleep=time.sleep):
+        self._rules = list(rules)
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._sleep = sleep
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self.fired: dict[str, int] = {}
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0, clock=time.monotonic,
+                  sleep=time.sleep) -> "FaultInjector":
+        return cls(parse_rules(spec), seed=seed, clock=clock, sleep=sleep)
+
+    def _armed(self, rule: FaultRule, now_s: float) -> bool:
+        if now_s < rule.after_s:
+            return False
+        if rule.for_s is not None and now_s >= rule.after_s + rule.for_s:
+            return False
+        if rule.count is not None and rule.fired >= rule.count:
+            return False
+        return True
+
+    def check(self, site: str) -> None:
+        """Apply every matching armed rule: latency rules sleep, error
+        rules raise (first match wins for raises). Thread-safe; draws are
+        serialized so a fixed seed stays reproducible."""
+        delay = 0.0
+        raise_rule: FaultRule | None = None
+        with self._lock:
+            now_s = self._clock() - self._t0
+            for rule in self._rules:
+                if not rule.matches(site) or not self._armed(rule, now_s):
+                    continue
+                if rule.p < 1.0 and self._rng.random() >= rule.p:
+                    continue
+                rule.fired += 1
+                self.fired[site] = self.fired.get(site, 0) + 1
+                if rule.kind == "latency":
+                    delay += rule.latency_s
+                elif raise_rule is None:
+                    raise_rule = rule
+        if delay:
+            self._sleep(delay)
+        if raise_rule is None:
+            return
+        kind = raise_rule.kind
+        _log.debug("injecting fault", site=site, kind=kind)
+        if kind in ("unavailable", "handshake"):
+            raise InjectedRpcError(kind, site)
+        if kind == "disk_full":
+            raise injected_disk_full(site)
+        if kind == "crash":
+            raise InjectedCrash(f"injected crash at {site}")
+        raise InjectedFault(f"injected fault at {site}")
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.fired)
+
+
+# -- process-global installation ---------------------------------------------
+
+_active: FaultInjector | None = None
+
+
+def install(injector: FaultInjector | None) -> None:
+    """Install (or with None, remove) the process-wide injector. The CLI
+    calls this once at startup; tests install/uninstall around cases."""
+    global _active
+    _active = injector
+
+
+def get() -> FaultInjector | None:
+    return _active
+
+
+def inject(site: str) -> None:
+    """The site hook: free when no injector is installed."""
+    if _active is not None:
+        _active.check(site)

@@ -105,6 +105,24 @@ class ErrorFS:
     read_bytes = exists = listdir = open = stat_signature = _raise
 
 
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-atomic file write: tmp sibling + os.replace, tmp cleaned on
+    failure. Readers of `path` only ever see a whole file (the
+    local-store profile writer and the spill spool both depend on this
+    — a crash mid-write must never leave a truncated artifact)."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def fake_procfs(pids: Iterable[int], extra: dict[str, bytes] | None = None) -> FakeFS:
     """A minimal /proc skeleton for the given pids."""
     files = {}

@@ -4,8 +4,10 @@ Role of the reference's mux in cmd/parca-agent/main.go:269-503 and the
 status template in pkg/template: `/` renders active profilers and
 per-process profiling state with query links; `/metrics` serves Prometheus
 text exposition; `/query` returns the next matching raw profile (backed by
-the MatchingProfileListener); `/healthy` is the liveness probe. Built on
-http.server (stdlib) so the shell has zero web dependencies.
+the MatchingProfileListener); `/healthy` is the liveness probe; `/healthz`
+is the supervised readiness probe (per-actor healthy/degraded/dead from
+the run group, docs/robustness.md). Built on http.server (stdlib) so the
+shell has zero web dependencies.
 """
 
 from __future__ import annotations
@@ -54,7 +56,8 @@ def render_status_page(profilers, version: str = "dev",
     )
 
 
-def render_metrics(profilers, batch_client=None, extra: dict | None = None) -> str:
+def render_metrics(profilers, batch_client=None, extra: dict | None = None,
+                   supervisor=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics."""
     lines = []
@@ -107,6 +110,36 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None) -> s
             series, samples = batch_client.buffered()
             emit("parca_agent_remote_write_buffered_series", series)
             emit("parca_agent_remote_write_buffered_samples", samples)
+        if hasattr(batch_client, "buffer_bytes"):
+            # Outage observability (docs/robustness.md): the RSS-proxy
+            # half of the ship path's bounded footprint...
+            emit("parca_agent_remote_write_buffer_bytes",
+                 batch_client.buffer_bytes())
+        if hasattr(batch_client, "replay_backlog"):
+            # ...and the disk half, plus drop/replay accounting.
+            segs, sbytes = batch_client.replay_backlog()
+            emit("parca_agent_spool_segments", segs)
+            emit("parca_agent_spool_bytes", sbytes)
+            emit("parca_agent_replay_lag_seconds",
+                 round(batch_client.replay_lag_s(), 3))
+            # The spool's own loss accounting (oldest-segment eviction,
+            # disk errors, corruption): the long-outage data-loss path
+            # must be visible, not just the in-memory one.
+            for k, v in batch_client.spool_stats().items():
+                emit(f"parca_agent_spool_{k}", v)
+        for k, v in getattr(batch_client, "stats", {}).items():
+            emit(f"parca_agent_remote_write_{k}", v)
+    if supervisor is not None:
+        # Per-actor supervision state: restarts and liveness per actor,
+        # plus the overall health as a 0/1/2 gauge (healthy/degraded/dead).
+        for name, h in supervisor.health().items():
+            lab = f'{{actor="{name}"}}'
+            emit("parca_agent_actor_restarts_total", h["restarts"], lab)
+            emit("parca_agent_actor_alive", int(h["alive"]), lab)
+            emit("parca_agent_actor_degraded",
+                 int(h["state"] == "degraded"), lab)
+        emit("parca_agent_health",
+             {"healthy": 0, "degraded": 1, "dead": 2}[supervisor.overall()])
     for k, v in (extra or {}).items():
         emit(k, v)
     return "\n".join(lines) + "\n"
@@ -116,7 +149,7 @@ class AgentHTTPServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 7071,
                  profilers=(), batch_client=None, listener=None,
                  version: str = "dev", extra_metrics=None,
-                 capture_info=None):
+                 capture_info=None, supervisor=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -140,9 +173,12 @@ class AgentHTTPServer:
                 elif url.path == "/metrics":
                     extra = outer.extra_metrics() if outer.extra_metrics else {}
                     self._send(200, render_metrics(
-                        outer.profilers, outer.batch_client, extra).encode())
+                        outer.profilers, outer.batch_client, extra,
+                        supervisor=outer.supervisor).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
+                elif url.path == "/healthz":
+                    self._healthz()
                 elif url.path == "/query":
                     self._query(url)
                 elif url.path.startswith("/debug/pprof"):
@@ -190,6 +226,25 @@ class AgentHTTPServer:
                 else:
                     self._send(404, b"unknown profile\n")
 
+            def _healthz(self):
+                """Supervised readiness: per-actor states from the run
+                group (healthy/degraded/dead/exited). 200 while the agent
+                is healthy or degraded (restarts in progress still serve
+                profiles); 503 once a critical actor is dead. Without a
+                supervisor wired, reports plain liveness like /healthy."""
+                if outer.supervisor is None:
+                    self._send(200, json.dumps(
+                        {"status": "healthy", "actors": {}}).encode(),
+                        "application/json")
+                    return
+                status = outer.supervisor.overall()
+                body = json.dumps({
+                    "status": status,
+                    "actors": outer.supervisor.health(),
+                }, indent=1).encode()
+                self._send(503 if status == "dead" else 200, body,
+                           "application/json")
+
             def _send_attachment(self, body: bytes, filename: str):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
@@ -230,6 +285,7 @@ class AgentHTTPServer:
         self.profilers = list(profilers)
         self.batch_client = batch_client
         self.listener = listener
+        self.supervisor = supervisor
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info

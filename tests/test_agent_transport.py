@@ -2,6 +2,7 @@
 writers, and a live in-process gRPC loopback."""
 
 import gzip
+import random
 import threading
 import time
 
@@ -54,15 +55,33 @@ def test_batch_merges_by_labelset():
     assert by_pid == {"1": [b"a", b"b"], "2": [b"c"]}
 
 
-def test_batch_retries_with_backoff_then_succeeds():
+def test_batch_retries_with_jittered_backoff_then_succeeds():
     store = RecordingStore(fail_times=2)
     slept = []
     c = BatchWriteClient(store, interval_s=10.0, initial_backoff_s=0.1,
-                         sleep=slept.append)
+                         sleep=slept.append, rng=random.Random(42))
     c.write_raw({"pid": "1"}, b"a")
     assert c.flush()
-    assert slept == [0.1, 0.2]  # exponential
+    # Full-jitter backoff: each sleep ~ U(0, cap) with the cap doubling
+    # (0.1 then 0.2) — bounded and deterministic under the seed.
+    assert len(slept) == 2
+    assert 0.0 <= slept[0] <= 0.1 and 0.0 <= slept[1] <= 0.2
+    expect = random.Random(42)
+    assert slept == [expect.uniform(0, 0.1), expect.uniform(0, 0.2)]
     assert c.send_errors == 2 and c.sent_batches == 1
+
+
+def test_batch_retry_budget_bounds_one_flush():
+    """The per-interval retry budget caps send attempts even when the
+    interval deadline is far away (herd control after a store restart)."""
+    store = RecordingStore(fail_times=99)
+    c = BatchWriteClient(store, interval_s=1e9, initial_backoff_s=0.0,
+                         retry_budget=3, rng=random.Random(1))
+    c.write_raw({"pid": "1"}, b"a")
+    assert not c.flush()
+    assert c.send_errors == 4  # initial attempt + 3 budgeted retries
+    assert c.stats["retry_budget_exhausted"] == 1
+    assert c.buffered() == (1, 1)  # restored, not lost
 
 
 def test_batch_failure_restores_buffer():
@@ -397,6 +416,68 @@ def test_cert_name_unparseable_is_empty_and_logged():
     from parca_agent_tpu.agent import grpc_client as gc
 
     assert gc._cert_name("not a pem") == ""
+
+
+# -- final-drain / restore-ordering / host:port satellites --------------------
+
+
+def test_batch_final_drain_ships_samples_written_after_stop():
+    """stop() before run(): the loop body never runs, but the final drain
+    still flushes whatever is buffered — a draining agent ships every
+    window it aggregated."""
+    store = RecordingStore()
+    c = BatchWriteClient(store, interval_s=3600.0)
+    c.write_raw({"pid": "1"}, b"late")
+    c.stop()
+    c.run()  # returns immediately: stop is set, then drains
+    assert store.batches and store.batches[0][0].samples == [b"late"]
+    assert c.buffered() == (0, 0)
+
+
+def test_batch_final_drain_gives_up_after_one_attempt_when_stopped():
+    """With stop set, a failing drain must not spin its full retry
+    budget (shutdown latency); the batch survives in the buffer (or
+    spool) for the next process."""
+    store = RecordingStore(fail_times=99)
+    slept = []
+    c = BatchWriteClient(store, interval_s=10.0, sleep=slept.append)
+    c.write_raw({"pid": "1"}, b"a")
+    c.stop()
+    c.run()
+    assert slept == []          # no backoff sleeps while stopping
+    assert c.send_errors == 1   # exactly one drain attempt
+    assert c.buffered() == (1, 1)
+
+
+def test_restore_merges_failed_batch_ahead_of_newer_samples():
+    """_restore ordering: after a failed flush, the failed batch's series
+    come FIRST (both in sample order within a series and in series
+    iteration order), so the store receives history oldest-first on the
+    next attempt."""
+    store = RecordingStore(fail_times=1)
+    c = BatchWriteClient(store, interval_s=0.0, retry_budget=0)
+    c.write_raw({"pid": "1"}, b"old-1")
+    c.write_raw({"pid": "2"}, b"old-2")
+    assert not c.flush()
+    # Newer samples arrive for an existing series AND a brand-new one.
+    c.write_raw({"pid": "1"}, b"new-1")
+    c.write_raw({"pid": "3"}, b"new-3")
+    assert c.flush()
+    (batch,) = store.batches
+    assert [s.labels["pid"] for s in batch] == ["1", "2", "3"]
+    assert batch[0].samples == [b"old-1", b"new-1"]  # failed batch first
+
+
+def test_split_host_port_edge_cases():
+    from parca_agent_tpu.agent.grpc_client import _split_host_port
+
+    assert _split_host_port("host.example:7070") == ("host.example", 7070)
+    assert _split_host_port("host.example") == ("host.example", 443)
+    assert _split_host_port("host.example:") == ("host.example", 443)
+    assert _split_host_port("[2001:db8::1]") == ("2001:db8::1", 443)
+    assert _split_host_port("[2001:db8::1]:7070") == ("2001:db8::1", 7070)
+    assert _split_host_port("[2001:db8::1]:") == ("2001:db8::1", 443)
+    assert _split_host_port("host:notaport") == ("host:notaport", 443)
 
 
 def test_batch_buffered_depth_gauge():
