@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-live chaos bench fixtures golden clean install
+.PHONY: all native test test-live chaos fuzz bench fixtures golden clean install
 
 all: native
 
@@ -26,7 +26,14 @@ test-live:
 # outages, disk-full spill, actor crashes — deterministic by design, so
 # it also rides every unmarked run.
 chaos:
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py -q -m chaos
+
+# Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
+# >=500 seeded mutations per ingest parser, nothing may escape the
+# PoisonInput taxonomy. Same harness the bench ingest_poison phase runs.
+fuzz:
+	PARCA_FAULT_SEED=42 PARCA_FUZZ_N=500 $(PYTHON) -m pytest \
+		tests/test_ingest_poison.py -q -m chaos -k fuzz
 
 # The driver-scored benchmark: ONE JSON line on stdout.
 bench:

@@ -590,6 +590,19 @@ def run(emit=None) -> dict:
             extras["ship_soak_error"] = repr(e)[:200]
         _emit_partial()
 
+    # Ingest-poison containment (docs/robustness.md "ingest containment"):
+    # the per-pid quarantine + degradation ladder under scripted poisoned
+    # inputs, plus the parser mutation-fuzz gate. Host-side only, like
+    # ship_soak: it can neither hang the attempt nor disturb the headline.
+    if os.environ.get("PARCA_BENCH_POISON", "1") != "0" \
+            and _budget_left(0.1, "ingest_poison"):
+        try:
+            extras["ingest_poison"] = _ingest_poison()
+            _progress(f"ingest poison done: {extras['ingest_poison']}")
+        except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+            extras["ingest_poison_error"] = repr(e)[:200]
+        _emit_partial()
+
     # Exact-vs-count-min A/B at the full unique-stack scale (BASELINE
     # config #4): the sketch is the bounded-memory degradation mode
     # (DictAggregator overflow="sketch"); publish its error envelope
@@ -674,6 +687,153 @@ def run(emit=None) -> dict:
             extras["batch_kernel_error"] = repr(e)[:120]
 
     return {**result, **extras}
+
+
+def _ingest_poison() -> dict:
+    """Ingest containment under scripted poison: 16 pids, 3 of them
+    emitting poisoned maps / perf-map / ELF inputs, run through the REAL
+    ingest path (mapping table build -> unwind build -> aggregate ->
+    ladder -> symbolize -> pprof) for a poisoned phase and a healed
+    phase. Reports the acceptance numbers — pids_quarantined,
+    windows_salvaged, samples_degraded, zero whole-window losses — plus
+    the drop-on-error BASELINE (no registry: the same poison aborts the
+    window build, the pre-containment behavior) and the parser
+    mutation-fuzz gate. Deterministic; milliseconds of wall time."""
+    from parca_agent_tpu.aggregator.cpu import CPUAggregator
+    from parca_agent_tpu.capture.formats import STACK_SLOTS, WindowSnapshot
+    from parca_agent_tpu.capture.live import mapping_table_for_pids
+    from parca_agent_tpu.pprof.builder import build_pprof
+    from parca_agent_tpu.process import maps as maps_mod
+    from parca_agent_tpu.process.maps import ProcessMapCache
+    from parca_agent_tpu.process.objectfile import ObjectFileCache
+    from parca_agent_tpu.runtime.quarantine import (
+        QuarantineRegistry,
+        apply_ladder,
+    )
+    from parca_agent_tpu.symbolize import perfmap as perfmap_mod
+    from parca_agent_tpu.symbolize.perfmap import PerfMapCache
+    from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+    from parca_agent_tpu.unwind.table import UnwindTableBuilder
+    from parca_agent_tpu.utils.fuzz import _sample_elf, fuzz_all
+    from parca_agent_tpu.utils.poison import PoisonInput
+    from parca_agent_tpu.utils.vfs import FakeFS
+
+    ALL = list(range(1, 17))
+    POISONED = (2, 5, 9)
+
+    def good_maps(pid):
+        return b"%x-%x r-xp 0 fd:01 %d /bin/app%d\n" % (
+            0x1000 * pid, 0x1000 * pid + 0x800, pid, pid)
+
+    files = {}
+    for pid in ALL:
+        files[f"/proc/{pid}/maps"] = good_maps(pid)
+        files[f"/proc/{pid}/status"] = b"NSpid:\t%d\n" % pid
+        files[f"/proc/{pid}/root/bin/app{pid}"] = _sample_elf()
+    files["/proc/2/maps"] = b"".join(        # rows past the (lowered) cap
+        b"%x-%x r-xp 0 fd:01 2 /x\n" % (i * 0x1000, i * 0x1000 + 0x500)
+        for i in range(96))
+    files["/proc/5/root/tmp/perf-5.map"] = b"a" * 8192  # bytes past cap
+    files["/proc/9/root/bin/app9"] = b"\x7fELF" + b"\x02" * 20  # truncated
+    fs = FakeFS(files)
+
+    def snapshot(table):
+        stacks = np.zeros((len(ALL), STACK_SLOTS), np.uint64)
+        for i, pid in enumerate(ALL):
+            if pid == 5:   # JIT-shaped: forces the perf-map read
+                stacks[i, :2] = [0x7F0000005010, 0x7F0000005020]
+            else:
+                stacks[i, :2] = [0x1000 * pid + 0x10, 0x1000 * pid + 0x20]
+        return WindowSnapshot(
+            pids=list(ALL), tids=list(ALL), counts=[10] * len(ALL),
+            user_len=[2] * len(ALL), kernel_len=[0] * len(ALL),
+            stacks=stacks, mappings=table)
+
+    saved = (maps_mod._MAX_ROWS, perfmap_mod._MAX_BYTES)
+    maps_mod._MAX_ROWS, perfmap_mod._MAX_BYTES = 64, 4096
+    try:
+        reg = QuarantineRegistry(max_strikes=1, quarantine_windows=2,
+                                 probation_windows=2, escalate_after=1,
+                                 healthy_after_windows=3)
+        maps_cache = ProcessMapCache(fs=fs)
+        objs = ObjectFileCache(fs=fs)
+        builder = UnwindTableBuilder(fs=fs, quarantine=reg)
+        sym = Symbolizer(perf=PerfMapCache(fs=fs), quarantine=reg)
+        agg = CPUAggregator()
+
+        windows_shipped_all = 0
+        peak_quarantined = 0
+
+        def run_window():
+            nonlocal windows_shipped_all, peak_quarantined
+            table = mapping_table_for_pids(maps_cache, objs, ALL,
+                                           quarantine=reg)
+            for pid in ALL:
+                try:
+                    builder.table_for_pid(
+                        pid, maps_cache.executable_mappings(pid))
+                except (OSError, PoisonInput):
+                    pass
+            profiles = apply_ladder(agg.aggregate(snapshot(table)), reg)
+            sym.symbolize(profiles)
+            shipped = sum(1 for p in profiles
+                          if build_pprof(p, compress=False))
+            reg.tick_window()
+            if shipped == len(ALL):
+                windows_shipped_all += 1
+            peak_quarantined = max(peak_quarantined,
+                                   reg.counts()["quarantined"])
+
+        poisoned_windows = 6
+        for _ in range(poisoned_windows):
+            run_window()
+        quarantined_after_poison = list(reg.quarantined_pids())
+
+        # Drop-on-error baseline: without the registry the poisoned maps
+        # abort the whole window's table build — every poisoned window is
+        # a whole-window loss in the reference's model.
+        baseline_lost = 0
+        for _ in range(poisoned_windows):
+            try:
+                mapping_table_for_pids(ProcessMapCache(fs=fs), objs, ALL,
+                                       quarantine=None)
+            except PoisonInput:
+                baseline_lost += 1
+
+        # Heal the inputs; containment must hand the pids back.
+        fs.put("/proc/2/maps", good_maps(2))
+        fs.put("/proc/5/root/tmp/perf-5.map", b"7f0000005000 100 jit_ok\n")
+        fs.put("/proc/9/root/bin/app9", _sample_elf())
+        recovery_windows = 0
+        for _ in range(24):
+            run_window()
+            recovery_windows += 1
+            if not reg.quarantined_pids() \
+                    and reg.counts()["probation"] == 0:
+                break
+
+        fuzz = fuzz_all(n=int(os.environ.get("PARCA_FUZZ_N", "200")),
+                        seed=42)
+        return {
+            "pids": len(ALL),
+            "pids_poisoned": len(POISONED),
+            "pids_quarantined": peak_quarantined,
+            "quarantined_correct":
+                quarantined_after_poison == list(POISONED),
+            "windows_total": poisoned_windows + recovery_windows,
+            "windows_shipped_complete": windows_shipped_all,
+            "whole_window_losses":
+                poisoned_windows + recovery_windows - windows_shipped_all,
+            "baseline_windows_lost": baseline_lost,
+            "windows_salvaged": reg.stats["windows_salvaged_total"],
+            "samples_degraded": reg.stats["samples_degraded_total"],
+            "recoveries": reg.stats["recoveries_total"],
+            "recovered_all": not reg.quarantined_pids(),
+            "fuzz_mutations": sum(r["mutations"] for r in fuzz.values()),
+            "fuzz_escapes": sum(len(r["escapes"]) for r in fuzz.values()),
+        }
+    finally:
+        maps_mod._MAX_ROWS, perfmap_mod._MAX_BYTES = saved
 
 
 def _ship_soak() -> dict:

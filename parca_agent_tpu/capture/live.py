@@ -218,23 +218,57 @@ def decode_records_columnar_v1d(lib, buf, nbytes: int) -> tuple:
     return pids, tids, ulen, klen, stacks, counts
 
 
-def mapping_table_for_pids(maps_cache, objs_cache, pids) -> MappingTable:
+def mapping_table_for_pids(maps_cache, objs_cache, pids,
+                           quarantine=None) -> MappingTable:
     """MappingTable for a set of pids via the shared caches; pids that
     exited (maps unreadable) or are unattributable (< 0) are skipped —
     their rows keep raw addresses. Shared by the window-end snapshot
     build and the streaming feeder's per-drain mini-snapshots so the two
-    paths cannot drift."""
+    paths cannot drift.
+
+    Ingest containment (docs/robustness.md): with a quarantine registry,
+    a pid whose maps file is poison (PoisonInput) or whose processing
+    blows the per-pid deadline is charged against its error budget and
+    skipped — its samples stay unmapped and ride the degradation ladder —
+    instead of aborting the table build for every pid in the window.
+    Without a registry, PoisonInput propagates (the pre-containment
+    drop-on-error behavior the bench's ingest_poison baseline measures).
+    Scalar-level pids skip maps parsing entirely; address-level pids
+    keep maps (normalized addresses must travel) but skip ELF opens
+    (build_mapping_table's degraded path — the ELF is the suspect)."""
+    from parca_agent_tpu.utils.poison import PoisonInput
+
     per_pid = {}
+    healthy = {}
     for pid in pids:
         pid = int(pid)
         if pid < 0:
             continue
+        level = quarantine.level(pid) if quarantine is not None else 0
+        if level >= 2:
+            continue  # scalar ladder level: counts only, no mapping work
+        t0 = quarantine.clock() if quarantine is not None else 0.0
         try:
             per_pid[pid] = maps_cache.executable_mappings(pid)
         except OSError:
             continue
-    return build_mapping_table(per_pid, objs_cache.build_ids(per_pid),
-                               objcache=objs_cache)
+        except PoisonInput as e:
+            if quarantine is None:
+                raise
+            quarantine.record_error(pid, getattr(e, "site", "maps.parse"),
+                                    e)
+            continue
+        if quarantine is not None:
+            quarantine.check_deadline(pid, t0)
+            if quarantine.level(pid) == 0:
+                healthy[pid] = per_pid[pid]
+        else:
+            healthy[pid] = per_pid[pid]
+    # Build ids come from opening mapped ELFs — only healthy pids pay
+    # (and risk) that; a shared path mapped by any healthy pid still
+    # contributes its id for everyone.
+    return build_mapping_table(per_pid, objs_cache.build_ids(healthy),
+                               objcache=objs_cache, quarantine=quarantine)
 
 
 def columns_to_snapshot(
@@ -353,6 +387,10 @@ class UnwindTableCache:
 
         self._fs = fs or RealFS()
         self._builder = UnwindTableBuilder(fs=self._fs)
+        # Ingest containment: set (post-construction, by the sampler's
+        # quarantine property) to the shared per-pid registry; builds
+        # charge poison to the owning pid and skip laddered pids.
+        self.quarantine = None
         self._maps = map_cache
         self._regex = re.compile(comm_regex) if comm_regex else None
         self._refresh = refresh_s
@@ -419,6 +457,7 @@ class UnwindTableCache:
             from parca_agent_tpu.unwind.table import ShardedTable
 
             try:
+                self._builder.quarantine = self.quarantine
                 maps = self._maps.executable_mappings(pid)
                 # Store range-partitioned (the reference's (pid, shard)
                 # layout, maps.go:286-395): the walker's two-level lookup
@@ -431,11 +470,18 @@ class UnwindTableCache:
                     self._built_at[pid] = time.monotonic()
                 self.stats["builds"] += 1
             except Exception as e:
-                # table_for_pid maps known failure classes to OSError, but a
-                # malformed .eh_frame can raise anything (struct.error,
-                # IndexError, MemoryError). Record built_at so the poison pid
-                # is not re-queued every drain, and keep the worker alive for
-                # the other pids.
+                # table_for_pid contains the PoisonInput taxonomy itself
+                # (charging the pid's budget), but a maps read can raise
+                # MapsError here and defense-in-depth still wants the
+                # blanket guard (MemoryError from a hostile allocation).
+                # Record built_at so the poison pid is not re-queued every
+                # drain, and keep the worker alive for the other pids.
+                from parca_agent_tpu.utils.poison import PoisonInput
+
+                if self.quarantine is not None \
+                        and isinstance(e, PoisonInput):
+                    self.quarantine.record_error(
+                        pid, getattr(e, "site", "unwind.build"), e)
                 with self._lock:
                     self._built_at[pid] = time.monotonic()
                 self.stats["build_errors"] += 1
@@ -472,10 +518,17 @@ class UnwindTableCache:
     def build_now(self, pid: int) -> "ShardedTable | None":
         """Synchronous build (tests / tools)."""
         from parca_agent_tpu.unwind.table import ShardedTable
+        from parca_agent_tpu.utils.poison import PoisonInput
 
         try:
+            self._builder.quarantine = self.quarantine
             maps = self._maps.executable_mappings(pid)
         except OSError:
+            return None
+        except PoisonInput as e:
+            if self.quarantine is not None:
+                self.quarantine.record_error(
+                    pid, getattr(e, "site", "maps.parse"), e)
             return None
         table = ShardedTable.from_table(
             self._builder.table_for_pid(pid, maps))
@@ -563,6 +616,11 @@ class PerfEventSampler:
         self._cap = drain_cap_mb << 20
         self._maps = ProcessMapCache()
         self._objs = ObjectFileCache()
+        # Ingest containment: the CLI wires the shared per-pid quarantine
+        # registry here (via the `quarantine` property) so the window-end
+        # mapping build AND the DWARF unwind-table cache charge poisoned
+        # pids instead of failing the snapshot (runtime/quarantine.py).
+        self._quarantine = None
         # One reusable drain buffer: allocating + zeroing drain_cap_mb per
         # drain pass is pure churn on the capture path; only the n written
         # bytes are ever read back.
@@ -600,6 +658,16 @@ class PerfEventSampler:
         from parca_agent_tpu.unwind.walker import WalkStats
 
         self.walk_stats = WalkStats()
+
+    @property
+    def quarantine(self):
+        return self._quarantine
+
+    @quarantine.setter
+    def quarantine(self, registry) -> None:
+        self._quarantine = registry
+        if self._tables is not None:
+            self._tables.quarantine = registry
 
     # Counter properties stay truthful after close(): the native handle
     # is gone then (the C getters would see NULL and answer 0), so close
@@ -717,7 +785,8 @@ class PerfEventSampler:
                         np.zeros((0, STACK_SLOTS), np.uint64),
                         np.zeros(0, np.int64)))]
             pid_iter = np.unique(cols[0]).tolist()
-        table = mapping_table_for_pids(self._maps, self._objs, pid_iter)
+        table = mapping_table_for_pids(self._maps, self._objs, pid_iter,
+                                       quarantine=self.quarantine)
         period_ns = int(1e9 / self._freq)
         window_ns = int(self._window * 1e9)
         if self.capture_stack:

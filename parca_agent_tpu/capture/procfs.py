@@ -74,6 +74,27 @@ class ProcfsSampler:
         self._started = False
         # (path, start, offset) -> runtime entry addr; constant per mapping.
         self._entry_cache: dict[tuple, int | None] = {}
+        # Ingest containment: wired by the CLI like the perf sampler's
+        # registry — a pid whose maps file is poison is charged and
+        # skipped for the window, never allowed to abort the whole
+        # window's collect.
+        self.quarantine = None
+
+    def _pid_mappings(self, pid: int) -> list:
+        """executable_mappings with the poison taxonomy contained: an
+        exited pid or a poisoned maps file degrades to 'no mappings' for
+        this pid (charged when a registry is wired)."""
+        from parca_agent_tpu.utils.poison import PoisonInput
+
+        try:
+            return self._maps.executable_mappings(pid)
+        except OSError:
+            return []
+        except PoisonInput as e:
+            if self.quarantine is not None:
+                self.quarantine.record_error(
+                    pid, getattr(e, "site", "maps.parse"), e)
+            return []
 
     def _pids(self) -> list[int]:
         try:
@@ -92,12 +113,11 @@ class ProcfsSampler:
     def _entry_address(self, pid: int) -> int | None:
         """Runtime entry point: ELF entry + load bias of the exec mapping."""
         from parca_agent_tpu.elf.base import BaseError, compute_base
-        from parca_agent_tpu.elf.reader import ElfError, ElfFile
+        from parca_agent_tpu.elf.reader import ElfFile
+        from parca_agent_tpu.utils import poison
+        from parca_agent_tpu.utils.poison import PoisonInput, read_bounded
 
-        try:
-            maps = self._maps.executable_mappings(pid)
-        except OSError:
-            return None
+        maps = self._pid_mappings(pid)
         if not maps:
             return None
         m = maps[0]
@@ -105,12 +125,15 @@ class ProcfsSampler:
         if key in self._entry_cache:
             return self._entry_cache[key]
         try:
-            ef = ElfFile(self._fs.read_bytes(host_path(pid, m.path)))
+            ef = ElfFile(read_bounded(self._fs, host_path(pid, m.path),
+                                      poison.ELF_READ_CAP))
             base = compute_base(ef, ef.exec_load_segment(),
                                 m.start, m.end, m.offset)
             addr = (ef.entry + base) % 2**64
-        except (OSError, ElfError, BaseError):
-            # Unreadable binary: attribute to the mapping start.
+        except (OSError, PoisonInput, BaseError):
+            # Unreadable/poison binary (incl. injected elf.read faults —
+            # PoisonInput covers the whole ingest taxonomy): attribute
+            # to the mapping start.
             addr = m.start
         if len(self._entry_cache) > 4096:
             self._entry_cache.clear()
@@ -127,10 +150,7 @@ class ProcfsSampler:
             addr = self._entry_address(pid)
             if addr is None:
                 continue
-            try:
-                per_pid_maps[pid] = self._maps.executable_mappings(pid)
-            except OSError:
-                per_pid_maps[pid] = []
+            per_pid_maps[pid] = self._pid_mappings(pid)
             # Scale kernel ticks (USER_HZ) to the nominal sampling frequency
             # so counts are comparable with real samplers at frequency_hz.
             count = max(1, ticks * self._freq // USER_HZ)

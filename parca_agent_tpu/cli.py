@@ -72,6 +72,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault-seed", type=int, default=0,
                    help="seed for the fault injector's probability draws "
                         "(PARCA_FAULT_SEED env var)")
+    p.add_argument("--quarantine-max-strikes", type=int, default=3,
+                   help="ingest containment: per-pid input faults "
+                        "tolerated per budget window before the pid is "
+                        "quarantined and its samples ride the "
+                        "degradation ladder (docs/robustness.md); "
+                        "0 disables the quarantine registry entirely")
+    p.add_argument("--quarantine-windows", type=int, default=3,
+                   help="base quarantine length in windows (doubles per "
+                        "repeat trip, capped)")
+    p.add_argument("--quarantine-pid-deadline", type=float, default=0.0,
+                   help="per-pid ingest processing deadline in seconds; "
+                        "a pid whose maps/ELF processing exceeds it is "
+                        "charged an input fault (0 = no deadline)")
     p.add_argument("--remote-store-insecure-skip-verify",
                    action="store_true",
                    help="skip TLS certificate verification: the server's "
@@ -511,6 +524,23 @@ def run(argv=None) -> int:
     if args.fast_encode and not hasattr(aggregator, "window_counts"):
         raise SystemExit(
             "--fast-encode requires --aggregator dict/dict+cm/sharded")
+
+    # -- ingest containment --------------------------------------------------
+    # One per-pid error budget shared by every ingest-side consumer of
+    # untrusted input (docs/robustness.md "ingest containment"): the
+    # capture source's mapping build, the streaming feeder's per-drain
+    # mini-tables, the symbolizer, and the degradation ladder in the
+    # profiler's write path.
+    quarantine = None
+    if args.quarantine_max_strikes > 0:
+        from parca_agent_tpu.runtime.quarantine import QuarantineRegistry
+
+        quarantine = QuarantineRegistry(
+            max_strikes=args.quarantine_max_strikes,
+            quarantine_windows=args.quarantine_windows,
+            deadline_s=args.quarantine_pid_deadline or None)
+        if hasattr(source, "quarantine"):
+            source.quarantine = quarantine
     feeder = None
     if args.debug_process_names:
         from parca_agent_tpu.capture.live import CommFilterSource
@@ -543,14 +573,16 @@ def run(argv=None) -> int:
                 # the FIRST window too (the exact window the cold-statics
                 # transient hits); the profiler refreshes it per window.
                 prebuild_period_ns=int(
-                    1e9 / args.profiling_cpu_sampling_frequency))
+                    1e9 / args.profiling_cpu_sampling_frequency),
+                quarantine=quarantine)
             source.on_drain = feeder.on_drain
     profiler = CPUProfiler(
         source=source,
         aggregator=aggregator,
         fallback_aggregator=fallback,
         symbolizer=(None if args.fast_encode
-                    else Symbolizer(ksym=KsymCache(), perf=PerfMapCache())),
+                    else Symbolizer(ksym=KsymCache(), perf=PerfMapCache(),
+                                    quarantine=quarantine)),
         labels_manager=labels_mgr,
         profile_writer=writer,
         debuginfo=debuginfo,
@@ -564,6 +596,7 @@ def run(argv=None) -> int:
         streaming_feeder=feeder,
         encode_pipeline=args.fast_encode and not args.no_encode_pipeline,
         encode_deadline_s=args.encode_deadline or None,
+        quarantine=quarantine,
     )
 
     # -- supervision ---------------------------------------------------------
@@ -649,7 +682,7 @@ def run(argv=None) -> int:
                            listener=listener, version=binfo.display(),
                            extra_metrics=capture_metrics,
                            capture_info=capture_metrics,
-                           supervisor=sup)
+                           supervisor=sup, quarantine=quarantine)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None

@@ -19,7 +19,9 @@ import os
 import struct
 import zlib
 
-from parca_agent_tpu.elf.reader import ElfError, ElfFile
+from parca_agent_tpu.elf.reader import ElfFile
+from parca_agent_tpu.utils import poison
+from parca_agent_tpu.utils.poison import PoisonInput, read_bounded
 from parca_agent_tpu.process.maps import host_path
 from parca_agent_tpu.utils.vfs import VFS, RealFS
 
@@ -54,12 +56,13 @@ class Finder:
         debuginfo file, or None."""
         if data is None:
             try:
-                data = self.fs.read_bytes(host_path(pid, binary_path))
-            except OSError:
+                data = read_bounded(self.fs, host_path(pid, binary_path),
+                                    poison.ELF_READ_CAP)
+            except (OSError, PoisonInput):
                 return None
         try:
             ef = ElfFile(data)
-        except ElfError:
+        except PoisonInput:
             return None
         if build_id is None:
             from parca_agent_tpu.elf.buildid import gnu_build_id
@@ -90,9 +93,12 @@ class Finder:
                 if not self.fs.exists(p):
                     continue
                 try:
-                    if zlib.crc32(self.fs.read_bytes(p)) == crc:
+                    # Bounded: candidates live under the target's mount
+                    # namespace — a staged sparse bomb must not be read.
+                    if zlib.crc32(read_bounded(self.fs, p,
+                                               poison.ELF_READ_CAP)) == crc:
                         return p
-                except OSError:
+                except (OSError, PoisonInput):
                     continue
 
         # 3. canonical path

@@ -24,8 +24,10 @@ from typing import Protocol
 
 from parca_agent_tpu.debuginfo.extract import extract_debuginfo
 from parca_agent_tpu.debuginfo.find import Finder
-from parca_agent_tpu.elf.reader import ElfError, ElfFile
+from parca_agent_tpu.elf.reader import ElfFile
 from parca_agent_tpu.process.maps import host_path
+from parca_agent_tpu.utils import poison
+from parca_agent_tpu.utils.poison import PoisonInput, read_bounded
 from parca_agent_tpu.utils.log import get_logger
 
 _log = get_logger("debuginfo")
@@ -156,18 +158,21 @@ class DebuginfoManager:
 
     def _debug_payload(self, pid: int, path: str, build_id: str) -> bytes | None:
         try:
-            raw = self._fs.read_bytes(host_path(pid, path))
-        except OSError:
+            # Bounded: the path comes from the target's mount namespace —
+            # a staged multi-GB sparse "binary" must not OOM the agent.
+            raw = read_bounded(self._fs, host_path(pid, path),
+                               poison.ELF_READ_CAP)
+        except (OSError, PoisonInput):
             return None
         sep = self._finder.find(pid, path, data=raw, build_id=build_id)
         if sep is not None:
             try:
-                payload = self._fs.read_bytes(sep)
+                payload = read_bounded(self._fs, sep, poison.ELF_READ_CAP)
                 ElfFile(payload)  # validate
                 with self._lock:
                     self.stats.found_separate += 1
                 return payload
-            except (OSError, ElfError):
+            except (OSError, PoisonInput):
                 pass
         if not self._strip:
             # --debuginfo-strip=false: ship the exact binary unmodified

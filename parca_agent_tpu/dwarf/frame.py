@@ -21,14 +21,23 @@ import dataclasses
 import enum
 import struct
 
+from parca_agent_tpu.utils.poison import PoisonInput
+
 # x86_64 DWARF register numbers (System V ABI).
 REG_RBP = 6
 REG_RSP = 7
 REG_RA = 16
 
 
-class FrameError(ValueError):
-    pass
+class FrameError(PoisonInput):
+    site = "unwind.build"
+
+
+# Poison caps (docs/robustness.md "ingest containment"): .eh_frame comes
+# from arbitrary host binaries; bound what one section may claim before
+# the parser materializes it. glibc carries ~25k FDEs; chromium ~600k.
+_MAX_CFI_ENTRIES = 2_000_000
+_MAX_LEB_SHIFT = 70  # > 64 value bits in a LEB128 is malformed
 
 
 # -- LEB128 -----------------------------------------------------------------
@@ -38,19 +47,27 @@ def uleb128(data: bytes, pos: int) -> tuple[int, int]:
     result = 0
     shift = 0
     while True:
-        b = data[pos]
+        try:
+            b = data[pos]
+        except IndexError:
+            raise FrameError("truncated ULEB128") from None
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:
             return result, pos
         shift += 7
+        if shift > _MAX_LEB_SHIFT:
+            raise FrameError("overlong ULEB128")
 
 
 def sleb128(data: bytes, pos: int) -> tuple[int, int]:
     result = 0
     shift = 0
     while True:
-        b = data[pos]
+        try:
+            b = data[pos]
+        except IndexError:
+            raise FrameError("truncated SLEB128") from None
         pos += 1
         result |= (b & 0x7F) << shift
         shift += 7
@@ -58,6 +75,8 @@ def sleb128(data: bytes, pos: int) -> tuple[int, int]:
             if b & 0x40:
                 result -= 1 << shift
             return result, pos
+        if shift > _MAX_LEB_SHIFT:
+            raise FrameError("overlong SLEB128")
 
 
 # -- DW_EH_PE pointer encodings --------------------------------------------
@@ -194,12 +213,27 @@ def parse_eh_frame(data: bytes, section_addr: int = 0) -> list[FDE]:
 
     `section_addr` is the sh_addr of .eh_frame, needed for pcrel pointer
     encodings (the common case for PIC code).
+
+    Malformed input raises FrameError (a PoisonInput) — including any
+    truncation an untrusted binary can produce (struct/index failures are
+    converted so nothing but the taxonomy escapes).
     """
+    try:
+        return _parse_eh_frame(data, section_addr)
+    except FrameError:
+        raise
+    except (IndexError, struct.error, ValueError) as e:
+        raise FrameError(f"malformed .eh_frame: {e!r}") from None
+
+
+def _parse_eh_frame(data: bytes, section_addr: int) -> list[FDE]:
     cies: dict[int, CIE] = {}
     fdes: list[FDE] = []
     pos = 0
     n = len(data)
     while pos + 4 <= n:
+        if len(cies) + len(fdes) >= _MAX_CFI_ENTRIES:
+            raise FrameError("CFI entry count exceeds cap")
         entry_off = pos
         length = struct.unpack_from("<I", data, pos)[0]
         pos += 4
@@ -229,12 +263,16 @@ def parse_eh_frame(data: bytes, section_addr: int = 0) -> list[FDE]:
 
 
 def _parse_cie(data: bytes, entry_off: int, pos: int, end: int) -> CIE:
+    if pos >= len(data):
+        raise FrameError("truncated CIE")
     version = data[pos]
     pos += 1
     if version not in (1, 3, 4):
         raise FrameError(f"unsupported CIE version {version}")
-    aug_end = data.index(b"\x00", pos)
-    augmentation = data[pos:aug_end].decode()
+    aug_end = data.find(b"\x00", pos, end)
+    if aug_end < 0:
+        raise FrameError("unterminated CIE augmentation string")
+    augmentation = data[pos:aug_end].decode(errors="replace")
     pos = aug_end + 1
     if version == 4:
         pos += 2  # address_size, segment_size
@@ -288,19 +326,25 @@ _DW_CFA_restore = 0xC0
 
 def execute_fde(fde: FDE) -> list[Row]:
     """Run CIE initial instructions + FDE instructions; one Row per distinct
-    starting location (reference table.go ExecuteDwarfProgram)."""
+    starting location (reference table.go ExecuteDwarfProgram). A CFA
+    program truncated or corrupted by its producer raises FrameError."""
     cie = fde.cie
     ctx = _Ctx(fde.pc_begin, cie.code_align, cie.data_align)
-    ctx.run(cie.initial_instructions)
-    ctx.initial = {k: v for k, v in ctx.regs.items()}
-    ctx.initial_cfa = ctx.cfa
-    rows = [ctx.snapshot()]
+    try:
+        ctx.run(cie.initial_instructions)
+        ctx.initial = {k: v for k, v in ctx.regs.items()}
+        ctx.initial_cfa = ctx.cfa
+        rows = [ctx.snapshot()]
 
-    def on_advance():
-        rows.append(ctx.snapshot())
+        def on_advance():
+            rows.append(ctx.snapshot())
 
-    ctx.on_advance = on_advance
-    ctx.run(fde.instructions)
+        ctx.on_advance = on_advance
+        ctx.run(fde.instructions)
+    except FrameError:
+        raise
+    except (IndexError, struct.error) as e:
+        raise FrameError(f"malformed CFA program: {e!r}") from None
     # Rows are emitted on advance with the PREVIOUS state; the final state
     # needs recording too.
     rows.append(ctx.snapshot())

@@ -29,6 +29,12 @@ _GO_MAGIC = b'\xff Go build ID: "'
 _GO_END = b'"\n \xff'
 _GO_SCAN_LIMIT = 32 * 1024
 
+# Poison cap: real ids are <=83 chars (Go) / 40 hex chars (GNU sha1). A
+# note desc claiming kilobytes is malformed input, not an identity —
+# treat the candidate as absent and fall through the precedence chain
+# (docs/robustness.md "ingest containment").
+_MAX_ID_LEN = 256
+
 
 def go_build_id(ef: ElfFile) -> str | None:
     sec = ef.section(".note.go.buildid")
@@ -36,7 +42,8 @@ def go_build_id(ef: ElfFile) -> str | None:
         from parca_agent_tpu.elf.reader import parse_notes
 
         for note in parse_notes(ef.section_data(sec), ef.end):
-            if note.name == "Go" and note.type == NT_GO_BUILD_ID and note.desc:
+            if note.name == "Go" and note.type == NT_GO_BUILD_ID \
+                    and note.desc and len(note.desc) <= _MAX_ID_LEN:
                 return note.desc.rstrip(b"\x00").decode(errors="replace")
     return None
 
@@ -61,14 +68,15 @@ def legacy_go_build_id(ef: ElfFile) -> str | None:
     if j < 0:
         return None
     raw = data[start:j]
-    if not raw or b"\x00" in raw:
+    if not raw or b"\x00" in raw or len(raw) > _MAX_ID_LEN:
         return None
     return raw.decode(errors="replace")
 
 
 def gnu_build_id(ef: ElfFile) -> str | None:
     for note in ef.notes():
-        if note.name == "GNU" and note.type == NT_GNU_BUILD_ID and note.desc:
+        if note.name == "GNU" and note.type == NT_GNU_BUILD_ID \
+                and note.desc and len(note.desc) <= _MAX_ID_LEN:
             return note.desc.hex()
     return None
 

@@ -5,12 +5,21 @@ debug/elf usage): identification, file header, program headers, section
 headers + names, note iteration, and symbol tables. Little- and big-endian
 ELF64 are supported; ELF32 is rejected (the capture targets are x86_64 /
 aarch64 processes, matching the reference's scope in bpf/cpu/cpu.bpf.c).
+
+Poison hardening (docs/robustness.md "ingest containment"): the bytes come
+from arbitrary host processes via /proc/<pid>/root, so every read is
+bounds-checked and every table capped; anything malformed raises ElfError,
+which is a PoisonInput — callers attribute it to the owning pid instead of
+failing the window. `faults.inject("elf.read")` is the chaos site.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import struct
+
+from parca_agent_tpu.utils import faults
+from parca_agent_tpu.utils.poison import PoisonInput
 
 ET_REL = 1
 ET_EXEC = 2
@@ -32,8 +41,13 @@ PF_R = 4
 SHF_COMPRESSED = 0x800
 
 
-class ElfError(ValueError):
-    pass
+class ElfError(PoisonInput):
+    site = "elf.read"
+
+
+# Symbol entries are 24 bytes; a smaller sh_entsize would make the read
+# loop walk overlapping garbage.
+_SYM_ENTSIZE_MIN = 24
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,6 +100,7 @@ class ElfFile:
     """Parsed ELF64 image over a bytes buffer."""
 
     def __init__(self, data: bytes):
+        faults.inject("elf.read")
         if len(data) < 64 or data[:4] != b"\x7fELF":
             raise ElfError("not an ELF file")
         ei_class = data[4]
@@ -200,6 +215,9 @@ class ElfFile:
         sec = self.section(section_name)
         if sec is None or sec.entsize == 0:
             return []
+        if sec.entsize < _SYM_ENTSIZE_MIN:
+            raise ElfError(
+                f"symbol entsize {int(sec.entsize)} below entry size")
         strsec = self.sections[sec.link] if sec.link < len(self.sections) else None
         strs = self.section_data(strsec) if strsec else b""
         data = self.section_data(sec)
@@ -220,11 +238,13 @@ def parse_notes(blob: bytes, end: str = "<") -> list[Note]:
     while pos + 12 <= len(blob):
         namesz, descsz, ntype = struct.unpack_from(end + "III", blob, pos)
         pos += 12
+        if namesz > len(blob) - pos:
+            break  # truncated record: name overruns the blob
         name = blob[pos: pos + namesz].rstrip(b"\x00").decode(errors="replace")
         pos += (namesz + 3) & ~3
+        if descsz > max(len(blob) - pos, 0):
+            break  # truncated record: desc overruns the blob
         desc = blob[pos: pos + descsz]
         pos += (descsz + 3) & ~3
-        if pos > len(blob) + 3:
-            break
         out.append(Note(name, ntype, desc))
     return out

@@ -113,18 +113,21 @@ class CompilerProvider:
 
     def labels(self, pid: int) -> dict[str, str]:
         from parca_agent_tpu.elf.buildid import go_build_id
-        from parca_agent_tpu.elf.reader import ElfError, ElfFile
+        from parca_agent_tpu.elf.reader import ElfFile
+        from parca_agent_tpu.utils import poison
+        from parca_agent_tpu.utils.poison import PoisonInput, read_bounded
 
         try:
             # /proc/pid/exe is a symlink to the main executable; reading
             # through it works on the real fs, and FakeFS tests key it
-            # directly.
-            data = self.fs.read_bytes(f"/proc/{pid}/exe")
-        except OSError:
+            # directly. Bounded: the target controls what it execs.
+            data = read_bounded(self.fs, f"/proc/{pid}/exe",
+                                poison.ELF_READ_CAP)
+        except (OSError, PoisonInput):
             return {}
         try:
             ef = ElfFile(data)
-        except ElfError:
+        except PoisonInput:
             return {}
         is_go = go_build_id(ef) is not None or \
             ef.section(".go.buildinfo") is not None

@@ -1,10 +1,15 @@
 """Process address-space introspection (reference pkg/process, pkg/objectfile,
 pkg/address)."""
 
-from parca_agent_tpu.process.maps import ProcMapping, parse_proc_maps, ProcessMapCache
+from parca_agent_tpu.process.maps import (
+    MapsError,
+    ProcMapping,
+    ProcessMapCache,
+    parse_proc_maps,
+)
 from parca_agent_tpu.process.objectfile import ObjectFile, ObjectFileCache
 
 __all__ = [
-    "ProcMapping", "parse_proc_maps", "ProcessMapCache",
+    "MapsError", "ProcMapping", "parse_proc_maps", "ProcessMapCache",
     "ObjectFile", "ObjectFileCache",
 ]

@@ -17,11 +17,31 @@ import dataclasses
 import numpy as np
 
 from parca_agent_tpu.capture.formats import MappingTable
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.filehash import hash_bytes
+from parca_agent_tpu.utils.log import get_logger
+from parca_agent_tpu.utils.poison import PoisonInput, read_bounded
 from parca_agent_tpu.utils.vfs import VFS, RealFS
+
+_log = get_logger("maps")
 
 # Pseudo-paths that are never ELF objects.
 _SPECIAL = ("[vdso]", "[vsyscall]", "[stack]", "[heap]", "[anon", "[uprobes]")
+
+
+class MapsError(PoisonInput):
+    site = "maps.parse"
+
+
+# Poison caps (docs/robustness.md "ingest containment"): the busiest
+# real processes sit around tens of thousands of mappings (the kernel's
+# own default cap is sysctl vm.max_map_count = 65530); a maps file past
+# these is a resource bomb from a hostile/broken process (a fake /proc
+# in its mount namespace), not a map. The BYTE cap bounds the read
+# itself — the bomb may never be fully materialized before rejection.
+_MAX_ROWS = 262_144
+_MAX_BYTES = 32 << 20
+_MASK64 = (1 << 64) - 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,7 +65,13 @@ class ProcMapping:
 
 
 def parse_proc_maps(data: bytes) -> list[ProcMapping]:
-    """Parse maps lines: start-end perms offset dev inode [path]."""
+    """Parse maps lines: start-end perms offset dev inode [path].
+
+    Malformed lines are skipped; values are masked to 64 bits (a hostile
+    process can remount a fake /proc in its namespace — an out-of-range
+    address must not blow up the whole window's uint64 table build
+    downstream); a file past the row cap raises MapsError (PoisonInput)
+    so the caller can quarantine the pid."""
     out = []
     for line in data.splitlines():
         parts = line.split(None, 5)
@@ -58,9 +84,16 @@ def parse_proc_maps(data: bytes) -> list[ProcMapping]:
             inode = int(parts[4])
         except ValueError:
             continue
+        if start < 0 or end < 0 or offset < 0:
+            continue
+        if len(out) >= _MAX_ROWS:
+            raise MapsError(f"maps file exceeds row cap ({_MAX_ROWS})")
         path = parts[5].decode(errors="replace").strip() if len(parts) == 6 else ""
-        out.append(ProcMapping(start, end, parts[1].decode(), offset,
-                               parts[3].decode(), inode, path))
+        out.append(ProcMapping(start & _MASK64, end & _MASK64,
+                               parts[1].decode(errors="replace"),
+                               offset & _MASK64,
+                               parts[3].decode(errors="replace"),
+                               inode, path))
     return out
 
 
@@ -72,7 +105,11 @@ class ProcessMapCache:
         self._cache: dict[int, tuple[int, list[ProcMapping]]] = {}
 
     def mappings_for_pid(self, pid: int) -> list[ProcMapping]:
-        data = self._fs.read_bytes(f"/proc/{pid}/maps")
+        """Raises OSError for exited/unreadable pids and PoisonInput
+        (MapsError or OversizedInput) for poisoned maps files."""
+        faults.inject("maps.parse")
+        data = read_bounded(self._fs, f"/proc/{pid}/maps", _MAX_BYTES,
+                            site="maps.parse")
         h = hash_bytes(data)
         cached = self._cache.get(pid)
         if cached and cached[0] == h:
@@ -98,6 +135,7 @@ def build_mapping_table(
     per_pid: dict[int, list[ProcMapping]],
     build_ids: dict[str, str] | None = None,
     objcache=None,
+    quarantine=None,
 ) -> MappingTable:
     """Fold executable file-backed mappings of many PIDs into one sorted
     MappingTable; objects dedup by path (as on a real host where every
@@ -107,26 +145,47 @@ def build_mapping_table(
     With an ObjectFileCache, each row's normalization base is derived from
     the mapped ELF's program headers (pprof GetBase semantics, reference
     pkg/objectfile/object_file.go:156-238); unreadable objects fall back to
-    base = start - offset."""
+    base = start - offset. Object failures are COUNTED per pid (logged
+    once per pid at debug), and with a quarantine registry attached they
+    feed the pid's error budget: a process that keeps mapping ELFs whose
+    headers blow up base computation is emitting poison. Pids already on
+    the degradation ladder skip the ELF open entirely (the file is the
+    suspected poison source) and take the file-offset fallback base."""
     build_ids = build_ids or {}
     obj_ids: dict[str, int] = {}
     rows: list[tuple[int, int, int, int, int, int]] = []
     for pid, maps in per_pid.items():
+        obj_failures = 0
+        last_err: Exception | None = None
+        degraded = quarantine is not None and quarantine.level(pid) > 0
+        t0 = quarantine.clock() if quarantine is not None else 0.0
         for m in maps:
             if not (m.executable and m.file_backed):
                 continue
             obj = obj_ids.setdefault(m.path, len(obj_ids))
             base = None
-            if objcache is not None:
+            if objcache is not None and not degraded:
                 of = objcache.get(pid, m)
                 if of is not None:
                     try:
                         base = of.base()
-                    except Exception:
+                    except Exception as e:  # noqa: BLE001 - counted below
+                        obj_failures += 1
+                        last_err = e
                         base = None
             if base is None:
                 base = (m.start - m.offset) % 2**64
             rows.append((pid, m.start, m.end, m.offset, obj, base))
+        if obj_failures:
+            _log.debug("object-file failures during mapping build",
+                       pid=pid, failures=obj_failures,
+                       error=repr(last_err))
+            if quarantine is not None:
+                quarantine.record_error(pid, "maps.objfile", last_err)
+        if quarantine is not None:
+            # The per-pid deadline covers the ELF opens above, not just
+            # the maps parse: an ELF that parses *slowly* is poison too.
+            quarantine.check_deadline(pid, t0)
     if not rows:
         return MappingTable.empty()
     rows.sort(key=lambda r: (r[0], r[1]))

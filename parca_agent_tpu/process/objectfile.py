@@ -18,8 +18,10 @@ from collections import OrderedDict
 
 from parca_agent_tpu.elf.base import BaseError, compute_base
 from parca_agent_tpu.elf.buildid import build_id
-from parca_agent_tpu.elf.reader import ElfError, ElfFile
+from parca_agent_tpu.elf.reader import ElfFile
 from parca_agent_tpu.process.maps import ProcMapping, host_path
+from parca_agent_tpu.utils import poison
+from parca_agent_tpu.utils.poison import PoisonInput, read_bounded
 from parca_agent_tpu.utils.vfs import VFS, RealFS
 
 
@@ -105,7 +107,9 @@ class ObjectFileCache:
         if hit is not None:
             self._elves.move_to_end(sig)
             return hit
-        elf = ElfFile(self._fs.read_bytes(path))
+        # Bounded read: a PROT_EXEC-mapped multi-GB sparse file must not
+        # be materialized before ElfFile can reject it.
+        elf = ElfFile(read_bounded(self._fs, path, poison.ELF_READ_CAP))
         entry = (elf.e_type, elf.exec_load_segment(), build_id(elf) or "")
         self._elves[sig] = entry
         while len(self._elves) > self._size:
@@ -128,7 +132,11 @@ class ObjectFileCache:
             e_type, seg, bid = self._file_meta(host_path(pid, mapping.path))
             obj = ObjectFile.from_meta(mapping.path, e_type, seg, bid,
                                        mapping)
-        except (OSError, ElfError, BaseError):
+        except (OSError, PoisonInput, BaseError):
+            # PoisonInput covers the whole ingest taxonomy (ElfError and
+            # any injected elf.read fault): a corrupt mapped binary
+            # degrades THIS object to fallback normalization, never the
+            # window's table build.
             obj = None
         self._cache[key] = (now, obj)
         self._cache.move_to_end(key)

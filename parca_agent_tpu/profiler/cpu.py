@@ -23,6 +23,7 @@ from typing import Callable, Protocol
 from parca_agent_tpu.aggregator.base import Aggregator, PidProfile
 from parca_agent_tpu.capture.formats import WindowSnapshot
 from parca_agent_tpu.pprof.builder import build_pprof
+from parca_agent_tpu.runtime.quarantine import apply_ladder
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
@@ -76,9 +77,17 @@ class CPUProfiler:
         streaming_feeder=None,
         encode_pipeline: bool = False,
         encode_deadline_s: float | None = None,
+        quarantine=None,
     ):
         self._source = source
         self._aggregator = aggregator
+        # Ingest containment (runtime/quarantine.py): the profiler owns
+        # the window clock, so it ticks the registry once per iteration
+        # and routes aggregated profiles down the degradation ladder
+        # before symbolize/write. The same registry instance is shared
+        # with the capture source, the feeder, the symbolizer, and the
+        # unwind builder — one budget per pid across every ingest site.
+        self._quarantine = quarantine
         # Fast write path: aggregate counts + vectorized template encoder,
         # no per-pid PidProfile objects or scalar pprof serialization on
         # the hot loop. Profiles ship unsymbolized (the reference agent's
@@ -288,6 +297,12 @@ class CPUProfiler:
                 profiles = self.obtain_profiles(snapshot)
                 self.metrics.samples_aggregated += snapshot.total_samples()
 
+                # Degradation ladder first (level-1 pids lose local
+                # symbols, level-2 pids collapse to scalar counts), then
+                # symbolize — which itself skips laddered pids, so a
+                # degraded profile can never be re-symbolized.
+                profiles = apply_ladder(profiles, self._quarantine)
+
                 if self._symbolizer is not None:
                     t0 = time.perf_counter()
                     self._symbolizer.symbolize(profiles)
@@ -321,6 +336,10 @@ class CPUProfiler:
             self.last_error = e
             self.metrics.errors_total += 1
             _log.warn("profile iteration failed", error=repr(e))
+        if self._quarantine is not None:
+            # Quarantine time is window time: cooldown/probation advance
+            # once per iteration, whether or not the window shipped.
+            self._quarantine.tick_window()
         self.metrics.last_attempt_duration_s = time.perf_counter() - t_start
         self._manage_gc(self.metrics.attempts_total)
         if self._on_iteration is not None:

@@ -65,10 +65,15 @@ class StreamingWindowFeeder:
                  reprobe_base_windows: int = 2,
                  reprobe_max_windows: int = 32,
                  prebuild_period_ns: int = 0,
-                 prebuild_budget_s: float = 0.25):
+                 prebuild_budget_s: float = 0.25,
+                 quarantine=None):
         self._agg = aggregator
         self._maps = maps_cache
         self._objs = objs_cache
+        # Ingest containment: the per-drain mini-table build reads the
+        # same untrusted /proc inputs as the window-end build; poisoned
+        # pids are charged and skipped per drain (runtime/quarantine.py).
+        self._quarantine = quarantine
         self._timeout = feed_timeout_s
         # The very FIRST feed attempt of the process gets the longer
         # budget: it includes the XLA compile of the feed program (tens
@@ -169,8 +174,17 @@ class StreamingWindowFeeder:
         pids, tids, ulen, klen, stacks, counts = cols
         if not len(pids):
             return
-        table = mapping_table_for_pids(self._maps, self._objs,
-                                       np.unique(pids).tolist())
+        try:
+            table = mapping_table_for_pids(self._maps, self._objs,
+                                           np.unique(pids).tolist(),
+                                           quarantine=self._quarantine)
+        except Exception as e:  # noqa: BLE001 - a poisoned maps file
+            # (PoisonInput surfaces here only without a registry) must
+            # cost this DRAIN, not the capture loop: skip the feed; the
+            # fed-mass mismatch makes the window one-shot, exactly right.
+            _log.warn("drain mapping build failed; skipping feed",
+                      error=repr(e))
+            return
         mini = columns_to_snapshot(pids, tids, ulen, klen, stacks,
                                    table, 0, 0, weights=counts)
         if len(mini) == 0:

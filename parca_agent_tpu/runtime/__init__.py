@@ -1,1 +1,2 @@
-"""Actor runtime: supervised run-groups for the always-on agent."""
+"""Actor runtime: supervised run-groups and per-pid ingest quarantine
+for the always-on agent."""

@@ -6,7 +6,7 @@ ships normalized addresses + build ids and is symbolized by the server.
 """
 
 from parca_agent_tpu.symbolize.ksym import KsymCache
-from parca_agent_tpu.symbolize.perfmap import PerfMapCache
+from parca_agent_tpu.symbolize.perfmap import PerfMapCache, PerfMapError
 from parca_agent_tpu.symbolize.symbolizer import Symbolizer
 
-__all__ = ["KsymCache", "PerfMapCache", "Symbolizer"]
+__all__ = ["KsymCache", "PerfMapCache", "PerfMapError", "Symbolizer"]

@@ -21,12 +21,18 @@ from collections import OrderedDict
 
 import numpy as np
 
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.filehash import hash_bytes
 from parca_agent_tpu.utils.vfs import VFS, RealFS
 
 _SKIP_TYPES = frozenset("bBdDrR")
 _DEFAULT_TTL_S = 300.0  # reference: 5 min (ksym.go:66-77)
 _LRU_SIZE = 10_000      # reference: 10k resolved addrs (ksym.go:35)
+# Poison caps: a real kallsyms is a few hundred thousand lines; the file
+# normally comes from the kernel, but snapshot/replay paths feed cached
+# copies that can be corrupt (docs/robustness.md "ingest containment").
+_MAX_SYMS = 4_000_000
+_MAX_ADDR = 2**64
 
 
 def parse_kallsyms(data: bytes) -> tuple[np.ndarray, list[str]]:
@@ -35,6 +41,9 @@ def parse_kallsyms(data: bytes) -> tuple[np.ndarray, list[str]]:
     Lines are `addr type name [module]`. Zero addresses (unprivileged read:
     kptr_restrict) parse fine and resolve to whatever the search finds —
     callers should treat an all-zero table as "no kallsyms access".
+    Malformed lines (bad hex, out-of-range addresses) are skipped, and the
+    table is truncated at a row cap, so a corrupt cache degrades coverage
+    instead of aborting the window's symbolization.
     """
     addrs: list[int] = []
     names: list[str] = []
@@ -48,6 +57,10 @@ def parse_kallsyms(data: bytes) -> tuple[np.ndarray, list[str]]:
             addr = int(parts[0], 16)
         except ValueError:
             continue
+        if not 0 <= addr < _MAX_ADDR:
+            continue
+        if len(addrs) >= _MAX_SYMS:
+            break
         addrs.append(addr)
         names.append(parts[2].decode(errors="replace"))
     a = np.array(addrs, np.uint64)
@@ -98,6 +111,7 @@ class KsymCache:
     def resolve(self, addrs) -> list[str | None]:
         """Resolve each address to the name of the last symbol at or below
         it (reference ksym.go:235-248). None when below the first symbol."""
+        faults.inject("symbolize.kernel")
         self._maybe_reload()
         addrs = np.asarray(addrs, np.uint64)
         out: list[str | None] = [None] * len(addrs)

@@ -10,6 +10,15 @@ pkg/perf/perf.go:128-142,165-209).
 Lookup contract matches the reference (perf.go:62-110): entries sorted by
 end address, binary search for the first entry with End > addr, hit iff its
 Start <= addr. Per-PID cache invalidated by content hash (perf.go:143-162).
+
+Poison hardening (docs/robustness.md "ingest containment"): the file is
+written by the *profiled process* — arbitrary and untrusted. Malformed
+LINES are tolerated and skipped (bad hex, negative or out-of-range
+start/size, wrong field count — unsorted and overlapping entries are fine
+by the lookup contract and need no rejection); whole-file poison — more
+rows than the row cap, a file past the byte cap — raises PerfMapError so
+the caller can quarantine the pid. `faults.inject("perfmap.parse")` is
+the chaos site.
 """
 
 from __future__ import annotations
@@ -18,7 +27,9 @@ import dataclasses
 
 import numpy as np
 
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.filehash import hash_bytes
+from parca_agent_tpu.utils.poison import PoisonInput, read_bounded
 from parca_agent_tpu.utils.vfs import VFS, RealFS
 
 
@@ -26,11 +37,23 @@ class NoSymbolFound(LookupError):
     pass
 
 
+class PerfMapError(PoisonInput):
+    site = "perfmap.parse"
+
+
+# Row/size caps: a hot JVM's perf map is a few hundred thousand rows and
+# tens of MB; past these the file is a resource bomb, not a symbol table.
+_MAX_ROWS = 1_000_000
+_MAX_BYTES = 64 << 20
+_MAX_ADDR = 2**64
+
+
 @dataclasses.dataclass
 class PerfMap:
     starts: np.ndarray  # uint64 [K], sorted by end
     ends: np.ndarray    # uint64 [K]
     names: list[str]
+    skipped_lines: int = 0  # malformed lines tolerated during parse
 
     def __len__(self) -> int:
         return len(self.names)
@@ -53,26 +76,46 @@ class PerfMap:
 
 
 def parse_perf_map(data: bytes) -> PerfMap:
-    """Parse `start size symbol-with-possible-spaces` lines (perf.go:62-95)."""
+    """Parse `start size symbol-with-possible-spaces` lines (perf.go:62-95).
+
+    Tolerant of malformed lines (skipped, counted); raises PerfMapError
+    when the FILE itself is poison (row cap / byte cap exceeded)."""
+    if len(data) > _MAX_BYTES:
+        raise PerfMapError(
+            f"perf map exceeds byte cap ({len(data)} > {_MAX_BYTES})")
     starts: list[int] = []
     sizes: list[int] = []
     names: list[str] = []
+    skipped = 0
     for line in data.splitlines():
         parts = line.split(b" ", 2)
         if len(parts) != 3:
+            if line.strip():
+                skipped += 1
             continue
         try:
             start = int(parts[0], 16)
             size = int(parts[1], 16)
         except ValueError:
+            skipped += 1
             continue
+        # int(.., 16) accepts a sign; a negative start/size (or one past
+        # the address space) is not a mapping, and would blow up the
+        # uint64 conversion below for every GOOD row of the file.
+        if not (0 <= start < _MAX_ADDR and 0 <= size
+                and start + size < _MAX_ADDR):
+            skipped += 1
+            continue
+        if len(starts) >= _MAX_ROWS:
+            raise PerfMapError(f"perf map exceeds row cap ({_MAX_ROWS})")
         starts.append(start)
         sizes.append(size)
         names.append(parts[2].decode(errors="replace").rstrip())
     s = np.array(starts, np.uint64)
     e = s + np.array(sizes, np.uint64)
     order = np.argsort(e, kind="stable")
-    return PerfMap(s[order], e[order], [names[i] for i in order])
+    return PerfMap(s[order], e[order], [names[i] for i in order],
+                   skipped_lines=skipped)
 
 
 def namespaced_pid(fs: VFS, pid: int) -> int:
@@ -82,7 +125,10 @@ def namespaced_pid(fs: VFS, pid: int) -> int:
         if line.startswith(b"NSpid:"):
             fields = line.split()
             if len(fields) >= 2:
-                return int(fields[-1])
+                try:
+                    return int(fields[-1])
+                except ValueError:
+                    break  # poisoned status line: fall back to host pid
     return pid
 
 
@@ -99,9 +145,17 @@ class PerfMapCache:
         self._cache: dict[int, tuple[int, PerfMap]] = {}
 
     def map_for_pid(self, pid: int) -> PerfMap:
-        """Raises FileNotFoundError when the process has no perf map."""
+        """Raises FileNotFoundError when the process has no perf map and
+        PoisonInput (PerfMapError or OversizedInput) when the map it
+        does have is poison.
+
+        The read itself is BOUNDED: the file is written by the profiled
+        process, so a multi-GB map must cost at most the byte cap of RSS
+        — never a full materialization before the cap check."""
+        faults.inject("perfmap.parse")
         path = perf_map_path(self._fs, pid)
-        data = self._fs.read_bytes(path)
+        data = read_bounded(self._fs, path, _MAX_BYTES,
+                            site="perfmap.parse")
         h = hash_bytes(data)
         cached = self._cache.get(pid)
         if cached and cached[0] == h:

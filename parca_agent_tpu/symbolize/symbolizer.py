@@ -19,19 +19,28 @@ import numpy as np
 from parca_agent_tpu.aggregator.base import PidProfile
 from parca_agent_tpu.symbolize.ksym import KsymCache
 from parca_agent_tpu.symbolize.perfmap import PerfMapCache
+from parca_agent_tpu.utils.poison import PoisonInput
 
 
 class Symbolizer:
     def __init__(self, ksym: KsymCache | None = None,
-                 perf: PerfMapCache | None = None):
+                 perf: PerfMapCache | None = None,
+                 quarantine=None):
         self._ksym = ksym
         self._perf = perf
+        self._quarantine = quarantine
         self.last_errors: dict[int, Exception] = {}
         self._fn_ids: dict[int, dict[str, int]] = {}
 
     def symbolize(self, profiles: Iterable[PidProfile]) -> None:
-        """Fill functions/loc_lines in place for each profile."""
+        """Fill functions/loc_lines in place for each profile. Pids on
+        the degradation ladder (runtime/quarantine.py) are skipped: their
+        profiles ship addresses-only, exactly the reference's
+        server-side-symbolization contract (symbol.go:55-139)."""
         profiles = list(profiles)
+        if self._quarantine is not None:
+            profiles = [p for p in profiles
+                        if self._quarantine.level(p.pid) == 0]
         self._fn_ids = {}
         self.last_errors = {}
         self._resolve_kernel(profiles)
@@ -51,15 +60,31 @@ class Symbolizer:
                 all_addrs.extend(int(a) for a in p.loc_address[idx])
         if not all_addrs:
             return
-        names = self._ksym.resolve(np.array(all_addrs, np.uint64))
+        try:
+            names = self._ksym.resolve(np.array(all_addrs, np.uint64))
+        except Exception as e:  # noqa: BLE001 - corrupt kallsyms cache
+            # must cost this window its KERNEL names, not the whole
+            # symbolization pass (JIT resolution still runs). Recorded
+            # per profile, like _resolve_jit's guard — but NOT fed to the
+            # pid error budget: kallsyms is kernel input, no pid owns it.
+            for p, _ in spans:
+                self.last_errors[p.pid] = e
+            return
         pos = 0
         for p, idx in spans:
-            self._ensure_lines(p)
-            for loc in idx:
-                name = names[pos]
-                pos += 1
-                if name:
-                    self._add_line(p, int(loc), name)
+            base = pos
+            pos += len(idx)
+            try:
+                self._ensure_lines(p)
+                for k, loc in enumerate(idx):
+                    name = names[base + k]
+                    if name:
+                        self._add_line(p, int(loc), name)
+            except Exception as e:  # noqa: BLE001 - one profile's attach
+                # failure (a poisoned profile shape) must not abort the
+                # remaining profiles; the cursor math above keeps the
+                # next span aligned regardless.
+                self.last_errors[p.pid] = e
 
     def _resolve_jit(self, profiles: list[PidProfile]) -> None:
         if self._perf is None:
@@ -78,9 +103,20 @@ class Symbolizer:
             )
             if not len(idx):
                 continue
+            t0 = (self._quarantine.clock()
+                  if self._quarantine is not None else 0.0)
             try:
                 pmap = self._perf.map_for_pid(p.pid)
             except FileNotFoundError:
+                continue
+            except PoisonInput as e:
+                # The pid's own perf map is poison: feed its error budget
+                # (the registry decides when it trips the ladder) and
+                # ship this profile without JIT names.
+                self.last_errors[p.pid] = e
+                if self._quarantine is not None:
+                    self._quarantine.record_error(
+                        p.pid, getattr(e, "site", "perfmap.parse"), e)
                 continue
             except Exception as e:  # pragma: no cover - defensive
                 self.last_errors[p.pid] = e
@@ -90,6 +126,10 @@ class Symbolizer:
             for loc, name in zip(idx, names):
                 if name:
                     self._add_line(p, int(loc), name)
+            if self._quarantine is not None:
+                # Per-pid deadline over the perf-map read+parse+lookup:
+                # a map that parses slowly is poison by time.
+                self._quarantine.check_deadline(p.pid, t0)
 
     def _ensure_lines(self, p: PidProfile) -> None:
         if p.loc_lines is None:

@@ -43,6 +43,8 @@ from parca_agent_tpu.dwarf.frame import (
 from parca_agent_tpu.elf.executable import is_aslr_eligible
 from parca_agent_tpu.elf.reader import ElfError, ElfFile
 from parca_agent_tpu.process.maps import ProcMapping, host_path
+from parca_agent_tpu.utils import faults, poison
+from parca_agent_tpu.utils.poison import PoisonInput, read_bounded
 from parca_agent_tpu.utils.vfs import VFS, RealFS
 
 ROW_DTYPE = np.dtype([
@@ -159,15 +161,28 @@ class UnwindTableBuilder:
     """unwind_table_for_pid: procfs + ELF -> one merged compact table.
 
     (reference UnwindTableForPid, unwind_table.go:117-183)
+
+    With a quarantine registry attached, poison inputs (corrupt ELF /
+    .eh_frame — PoisonInput from the parsers, chaos site `unwind.build`)
+    feed the owning pid's error budget, and pids already on the
+    degradation ladder skip the build entirely: their profiles ship
+    addresses-only (or scalar), and the suspect binaries are not re-read
+    until probation.
     """
 
     fs: VFS = dataclasses.field(default_factory=RealFS)
+    quarantine: object = None
 
     def table_for_mapping(self, pid: int, m: ProcMapping) -> np.ndarray | None:
         try:
-            data = self.fs.read_bytes(host_path(pid, m.path))
+            faults.inject("unwind.build")
+            data = read_bounded(self.fs, host_path(pid, m.path),
+                                poison.ELF_READ_CAP, site="unwind.build")
             ef = ElfFile(data)
-        except (OSError, ElfError):
+        except PoisonInput as e:
+            self._poisoned(pid, e)
+            return None
+        except OSError:
             return None
         sec = ef.section(".eh_frame")
         if sec is None:
@@ -185,11 +200,20 @@ class UnwindTableBuilder:
             bias = compute_base(ef, seg, m.start, m.end, m.offset)
         try:
             return build_compact_table(ef.section_data(sec), sec.addr, bias)
-        except FrameError:
+        except PoisonInput as e:  # FrameError / ElfError from section data
+            self._poisoned(pid, e)
             return None
+
+    def _poisoned(self, pid: int, e: PoisonInput) -> None:
+        if self.quarantine is not None:
+            self.quarantine.record_error(pid, getattr(e, "site",
+                                                      "unwind.build"), e)
 
     def table_for_pid(self, pid: int,
                       mappings: list[ProcMapping]) -> np.ndarray:
+        if self.quarantine is not None and self.quarantine.level(pid) > 0:
+            return np.zeros(0, ROW_DTYPE)  # ladder: no unwind for this pid
+        t0 = self.quarantine.clock() if self.quarantine is not None else 0.0
         parts = []
         for m in mappings:
             if not (m.executable and m.file_backed):
@@ -197,6 +221,10 @@ class UnwindTableBuilder:
             t = self.table_for_mapping(pid, m)
             if t is not None and len(t):
                 parts.append(t)
+        if self.quarantine is not None:
+            # Per-pid deadline over the whole build: a CFI section that
+            # executes slowly (huge FDE programs) is poison by time.
+            self.quarantine.check_deadline(pid, t0)
         if not parts:
             return np.zeros(0, ROW_DTYPE)
         return sort_rows(np.concatenate(parts))

@@ -12,6 +12,16 @@ layer the ship-path components consult at NAMED SITES:
     batch.flush       one flush attempt of the batch client
     actor.<name>      a supervised actor's loop tick (crash)
 
+and, on the ingest side (docs/robustness.md "ingest containment" — the
+``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an
+injected fault rides the same per-pid attribution path as real poison):
+
+    elf.read          ElfFile construction over untrusted bytes
+    perfmap.parse     reading + parsing a JIT perf map
+    maps.parse        parsing /proc/<pid>/maps
+    symbolize.kernel  the batched kallsyms resolve
+    unwind.build      building one mapping's unwind table
+
 Sites call :func:`inject` which is a no-op until an injector is installed
 (via the CLI's --fault-inject flag, the PARCA_FAULTS env var, or a test):
 production pays one module-attribute read per site.
@@ -26,6 +36,7 @@ Rule spec grammar (CLI/env), semicolon-separated::
     site:kind[:k=v[,k=v...]]
 
     kinds:  unavailable | handshake | error | latency | disk_full | crash
+            | poison
     keys:   p=<prob 0..1>   firing probability (default 1)
             after=<s>       rule arms this many seconds after install
             for=<s>         rule disarms this many seconds after arming
@@ -47,12 +58,23 @@ import threading
 import time
 
 from parca_agent_tpu.utils.log import get_logger
+from parca_agent_tpu.utils.poison import PoisonInput
 
 _log = get_logger("faults")
 
 
 class InjectedFault(Exception):
     """Base class for every injected failure (tests filter on it)."""
+
+
+class InjectedPoison(InjectedFault, PoisonInput):
+    """An injected malformed-input fault: both an InjectedFault (the
+    chaos suite filters on it) and a PoisonInput (the ingest containment
+    layer attributes it to a pid like real poison)."""
+
+    def __init__(self, site: str):
+        self.site = site
+        super().__init__(f"injected poison input at {site}")
 
 
 class InjectedCrash(InjectedFault):
@@ -112,7 +134,7 @@ class FaultRule:
 
 
 _KINDS = ("unavailable", "handshake", "error", "latency", "disk_full",
-          "crash")
+          "crash", "poison")
 
 
 def parse_rules(spec: str) -> list[FaultRule]:
@@ -201,6 +223,8 @@ class FaultInjector:
             raise injected_disk_full(site)
         if kind == "crash":
             raise InjectedCrash(f"injected crash at {site}")
+        if kind == "poison":
+            raise InjectedPoison(site)
         raise InjectedFault(f"injected fault at {site}")
 
     def stats(self) -> dict[str, int]:

@@ -57,7 +57,7 @@ def render_status_page(profilers, version: str = "dev",
 
 
 def render_metrics(profilers, batch_client=None, extra: dict | None = None,
-                   supervisor=None) -> str:
+                   supervisor=None, quarantine=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics."""
     lines = []
@@ -140,6 +140,23 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                  int(h["state"] == "degraded"), lab)
         emit("parca_agent_health",
              {"healthy": 0, "degraded": 1, "dead": 2}[supervisor.overall()])
+    if quarantine is not None:
+        # Ingest containment (docs/robustness.md): per-pid quarantine and
+        # degradation-ladder accounting — how many pids are degraded, how
+        # many windows shipped *because* of containment, and how much
+        # sample mass travelled down the ladder instead of being dropped.
+        # Lifecycle states and ladder levels are SEPARATE metrics: a
+        # quarantined pid is in exactly one state bucket and one level
+        # bucket, so each metric sums to a true pid count.
+        counts = quarantine.counts()
+        for state in ("quarantined", "probation", "watched"):
+            emit("parca_agent_quarantine_pids", counts[state],
+                 f'{{state="{state}"}}')
+        for level in ("addresses", "scalar"):
+            emit("parca_agent_quarantine_ladder_pids",
+                 counts[f"level_{level}"], f'{{level="{level}"}}')
+        for k, v in quarantine.stats.items():
+            emit(f"parca_agent_quarantine_{k}", v)
     for k, v in (extra or {}).items():
         emit(k, v)
     return "\n".join(lines) + "\n"
@@ -149,7 +166,7 @@ class AgentHTTPServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 7071,
                  profilers=(), batch_client=None, listener=None,
                  version: str = "dev", extra_metrics=None,
-                 capture_info=None, supervisor=None):
+                 capture_info=None, supervisor=None, quarantine=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -174,7 +191,8 @@ class AgentHTTPServer:
                     extra = outer.extra_metrics() if outer.extra_metrics else {}
                     self._send(200, render_metrics(
                         outer.profilers, outer.batch_client, extra,
-                        supervisor=outer.supervisor).encode())
+                        supervisor=outer.supervisor,
+                        quarantine=outer.quarantine).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
@@ -232,17 +250,27 @@ class AgentHTTPServer:
                 is healthy or degraded (restarts in progress still serve
                 profiles); 503 once a critical actor is dead. Without a
                 supervisor wired, reports plain liveness like /healthy."""
+                quarantine = (outer.quarantine.snapshot()
+                              if outer.quarantine is not None else None)
                 if outer.supervisor is None:
-                    self._send(200, json.dumps(
-                        {"status": "healthy", "actors": {}}).encode(),
-                        "application/json")
+                    body = {"status": "healthy", "actors": {}}
+                    if quarantine is not None:
+                        body["quarantine"] = quarantine
+                    self._send(200, json.dumps(body).encode(),
+                               "application/json")
                     return
                 status = outer.supervisor.overall()
-                body = json.dumps({
+                body = {
                     "status": status,
                     "actors": outer.supervisor.health(),
-                }, indent=1).encode()
-                self._send(503 if status == "dead" else 200, body,
+                }
+                if quarantine is not None:
+                    # Quarantined pids never turn /healthz red: the agent
+                    # is doing its job — containing them — but operators
+                    # need to see WHO is degraded and why.
+                    body["quarantine"] = quarantine
+                self._send(503 if status == "dead" else 200,
+                           json.dumps(body, indent=1).encode(),
                            "application/json")
 
             def _send_attachment(self, body: bytes, filename: str):
@@ -286,6 +314,7 @@ class AgentHTTPServer:
         self.batch_client = batch_client
         self.listener = listener
         self.supervisor = supervisor
+        self.quarantine = quarantine
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info

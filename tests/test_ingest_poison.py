@@ -1,0 +1,575 @@
+"""Ingest-side poison containment (chaos) suite.
+
+Deterministic like test_chaos.py: fixed seeds, in-memory FakeFS inputs.
+The headline test is test_scripted_poisoned_pids_window_survives — the
+ISSUE 4 acceptance scenario: 3 of 16 pids emit poisoned ELF / perf-map /
+maps inputs, the window still ships profiles for the other 13 pids (zero
+whole-window losses), the 3 pids land in quarantine and recover after
+probation. The fuzz gate runs >=500 seeded mutations per parser
+(PARCA_FUZZ_N raises it; `make fuzz`) asserting nothing escapes the
+PoisonInput taxonomy.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.process import maps as maps_mod
+from parca_agent_tpu.process.maps import (
+    MapsError,
+    ProcessMapCache,
+    parse_proc_maps,
+)
+from parca_agent_tpu.runtime.quarantine import QuarantineRegistry
+from parca_agent_tpu.symbolize import perfmap as perfmap_mod
+from parca_agent_tpu.symbolize.perfmap import (
+    PerfMapCache,
+    PerfMapError,
+    parse_perf_map,
+)
+from parca_agent_tpu.utils import faults
+from parca_agent_tpu.utils.poison import PoisonInput
+from parca_agent_tpu.utils.vfs import FakeFS
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.install(None)
+
+
+# -- parser hardening: table-driven malformed inputs --------------------------
+
+PERFMAP_MALFORMED = [
+    # (name, line, why it must be tolerated/skipped)
+    ("bad-hex-start", b"zzzz 10 f\n", "unparseable start"),
+    ("bad-hex-size", b"1000 qq f\n", "unparseable size"),
+    ("negative-start", b"-1000 10 f\n", "int(,16) accepts a sign"),
+    ("negative-size", b"1000 -10 f\n", "negative size wraps uint64"),
+    ("overflow-start", b"1" + b"0" * 20 + b" 10 f\n", "start past 2^64"),
+    ("overflow-end", b"ffffffffffffffff ff f\n", "start+size past 2^64"),
+    ("missing-name", b"1000 10\n", "two fields"),
+    ("empty", b"\n", "blank line"),
+    ("binary-garbage", bytes(range(256)) + b"\n", "non-text"),
+]
+
+
+@pytest.mark.parametrize("name,line,why", PERFMAP_MALFORMED)
+def test_perf_map_tolerates_malformed_line(name, line, why):
+    good = b"2000 100 jit_ok\n"
+    pm = parse_perf_map(line + good)
+    assert pm.lookup(0x2010) == "jit_ok", why
+    assert len(pm) == 1
+    if name != "empty":
+        assert pm.skipped_lines >= 1
+
+
+def test_perf_map_unsorted_and_overlapping_entries_still_resolve():
+    data = (b"3000 100 high\n"
+            b"1000 100 low\n"        # unsorted
+            b"1080 100 overlap\n")   # overlaps `low`
+    pm = parse_perf_map(data)
+    assert pm.lookup(0x3010) == "high"
+    assert pm.lookup(0x1010) == "low"
+    # Overlap resolves deterministically by the sorted-by-end contract.
+    assert pm.lookup_many([0x10a0])[0] in ("low", "overlap")
+
+
+def test_perf_map_row_cap_is_poison(monkeypatch):
+    monkeypatch.setattr(perfmap_mod, "_MAX_ROWS", 8)
+    data = b"".join(b"%x 10 f%d\n" % (0x1000 + i * 0x20, i)
+                    for i in range(9))
+    with pytest.raises(PerfMapError):
+        parse_perf_map(data)
+
+
+def test_perf_map_byte_cap_is_poison(monkeypatch):
+    monkeypatch.setattr(perfmap_mod, "_MAX_BYTES", 64)
+    with pytest.raises(PerfMapError):
+        parse_perf_map(b"a" * 65)
+
+
+def test_proc_maps_tolerates_malformed_lines():
+    data = (b"garbage\n"
+            b"zz-qq r-xp 0 fd:01 5 /x\n"
+            b"-5-1000 r-xp 0 fd:01 5 /x\n"
+            b"5000-6000 r-xp -4 fd:01 5 /x\n"       # negative offset
+            b"1000-2000 r-xp 100 fd:01 7 /bin/a\n")
+    out = parse_proc_maps(data)
+    assert len(out) == 1 and out[0].path == "/bin/a"
+
+
+def test_proc_maps_row_cap_is_poison(monkeypatch):
+    monkeypatch.setattr(maps_mod, "_MAX_ROWS", 4)
+    data = b"".join(b"%x-%x r-xp 0 fd:01 5 /x\n"
+                    % (0x1000 * i, 0x1000 * i + 0x500) for i in range(5))
+    with pytest.raises(MapsError):
+        parse_proc_maps(data)
+
+
+def test_kallsyms_tolerates_overflow_addresses():
+    from parca_agent_tpu.symbolize.ksym import parse_kallsyms
+
+    data = (b"1" + b"0" * 20 + b" T huge\n"
+            b"ffffffff81000000 T good\n")
+    addrs, names = parse_kallsyms(data)
+    assert names == ["good"]
+
+
+def test_elf_truncation_is_poison():
+    from parca_agent_tpu.elf.reader import ElfError, ElfFile
+    from parca_agent_tpu.utils.fuzz import _sample_elf
+
+    data = _sample_elf()
+    ElfFile(data)  # valid corpus parses
+    for cut in (0, 4, 63, len(data) // 2):
+        with pytest.raises((ElfError,)):
+            ef = ElfFile(data[:cut]) if cut >= 64 else ElfFile(data[:cut])
+            ef.sections
+            ef.notes()
+            ef.symbols()
+
+
+def test_eh_frame_truncation_is_poison_or_benign():
+    from parca_agent_tpu.dwarf.frame import FrameError, parse_eh_frame
+    from parca_agent_tpu.utils.fuzz import _sample_eh_frame
+
+    data = _sample_eh_frame()
+    assert len(parse_eh_frame(data)) == 1
+    for cut in range(1, len(data)):
+        try:
+            parse_eh_frame(data[:cut])
+        except FrameError:
+            pass  # contained
+
+
+# -- fault sites --------------------------------------------------------------
+
+
+def test_poison_kind_parses_and_raises_taxonomy():
+    inj = faults.FaultInjector.from_spec("maps.parse:poison:count=1")
+    with pytest.raises(PoisonInput) as ei:
+        inj.check("maps.parse")
+    assert isinstance(ei.value, faults.InjectedFault)
+    assert ei.value.site == "maps.parse"
+    inj.check("maps.parse")  # count exhausted: no-op
+
+
+def test_injected_poison_at_maps_site_feeds_quarantine():
+    from parca_agent_tpu.capture.live import mapping_table_for_pids
+    from parca_agent_tpu.process.objectfile import ObjectFileCache
+
+    fs = FakeFS({"/proc/7/maps": b"1000-2000 r-xp 0 fd:01 9 /bin/a\n"})
+    faults.install(faults.FaultInjector.from_spec("maps.parse:poison"))
+    reg = QuarantineRegistry(max_strikes=0)
+    table = mapping_table_for_pids(ProcessMapCache(fs=fs),
+                                   ObjectFileCache(fs=fs), [7],
+                                   quarantine=reg)
+    assert len(table.pids) == 0
+    assert reg.is_quarantined(7)
+    assert reg.snapshot()["pids"]["7"]["last_site"] == "maps.parse"
+
+
+def test_injected_poison_at_elf_site_contained_by_objcache():
+    """elf.read poison inside the object cache must degrade to base
+    fallback (get() -> None), never abort the table build."""
+    from parca_agent_tpu.process.objectfile import ObjectFileCache
+    from parca_agent_tpu.utils.fuzz import _sample_elf
+
+    fs = FakeFS({"/proc/7/maps": b"1000-2000 r-xp 0 fd:01 9 /bin/a\n",
+                 "/proc/7/root/bin/a": _sample_elf()})
+    cache = ProcessMapCache(fs=fs)
+    faults.install(faults.FaultInjector.from_spec("elf.read:poison"))
+    objs = ObjectFileCache(fs=fs)
+    from parca_agent_tpu.capture.live import mapping_table_for_pids
+
+    table = mapping_table_for_pids(cache, objs, [7])
+    assert len(table.pids) == 1
+    # file-offset fallback base
+    assert int(table.bases[0]) == 0x1000
+
+
+def test_injected_poison_at_unwind_site_feeds_quarantine():
+    from parca_agent_tpu.unwind.table import UnwindTableBuilder
+    from parca_agent_tpu.utils.fuzz import _sample_elf
+
+    fs = FakeFS({"/proc/7/root/bin/a": _sample_elf()})
+    m = parse_proc_maps(b"1000-2000 r-xp 0 fd:01 9 /bin/a\n")[0]
+    reg = QuarantineRegistry(max_strikes=0)
+    builder = UnwindTableBuilder(fs=fs, quarantine=reg)
+    faults.install(faults.FaultInjector.from_spec("unwind.build:poison"))
+    t = builder.table_for_pid(7, [m])
+    assert len(t) == 0
+    assert reg.is_quarantined(7)
+
+
+def test_injected_poison_at_perfmap_site_recorded_by_symbolizer():
+    from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+
+    fs = FakeFS({"/proc/5/status": b"NSpid:\t5\n",
+                 "/proc/5/root/tmp/perf-5.map": b"1000 10 f\n"})
+    reg = QuarantineRegistry(max_strikes=0)
+    sym = Symbolizer(perf=PerfMapCache(fs=fs), quarantine=reg)
+    prof = _jit_profile(5)
+    faults.install(faults.FaultInjector.from_spec("perfmap.parse:poison"))
+    sym.symbolize([prof])
+    assert reg.is_quarantined(5)
+    assert 5 in sym.last_errors
+
+
+def test_injected_poison_at_ksym_site_recorded_not_charged():
+    from parca_agent_tpu.symbolize.ksym import KsymCache
+    from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+
+    fs = FakeFS({"/proc/kallsyms": b"ffffffff81000000 T f\n"})
+    reg = QuarantineRegistry(max_strikes=0)
+    sym = Symbolizer(ksym=KsymCache(fs=fs), quarantine=reg)
+    prof = _jit_profile(5)
+    prof.loc_is_kernel[:] = True
+    faults.install(faults.FaultInjector.from_spec("symbolize.kernel:poison"))
+    sym.symbolize([prof])
+    assert 5 in sym.last_errors          # recorded...
+    assert not reg.is_quarantined(5)     # ...but kallsyms is nobody's pid
+
+
+def _jit_profile(pid):
+    from parca_agent_tpu.aggregator.base import PidProfile
+
+    return PidProfile(
+        pid=pid,
+        stack_loc_ids=np.array([[1]], np.int32),
+        stack_depths=np.array([1], np.int32),
+        values=np.array([2], np.int64),
+        loc_address=np.array([0x1005], np.uint64),
+        loc_normalized=np.array([0x1005], np.uint64),
+        loc_mapping_id=np.zeros(1, np.int32),
+        loc_is_kernel=np.zeros(1, bool),
+        mappings=[],
+        period_ns=10_000_000, time_ns=0, duration_ns=10**10,
+    )
+
+
+# -- the scripted acceptance scenario -----------------------------------------
+
+
+def _good_maps(pid):
+    return b"%x-%x r-xp 0 fd:01 %d /bin/app%d\n" % (
+        0x1000 * pid, 0x1000 * pid + 0x800, pid, pid)
+
+
+def _window_snapshot(pids, table):
+    from parca_agent_tpu.capture.formats import STACK_SLOTS, WindowSnapshot
+
+    n = len(pids)
+    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+    for i, pid in enumerate(pids):
+        if pid == 5:
+            # JIT-shaped addresses: outside every file-backed mapping,
+            # so symbolization consults the pid's (poisoned) perf map.
+            stacks[i, :2] = [0x7F0000005010, 0x7F0000005020]
+        else:
+            stacks[i, :2] = [0x1000 * pid + 0x10, 0x1000 * pid + 0x20]
+    return WindowSnapshot(
+        pids=list(pids), tids=list(pids), counts=[10] * n,
+        user_len=[2] * n, kernel_len=[0] * n,
+        stacks=stacks, mappings=table,
+    )
+
+
+def test_scripted_poisoned_pids_window_survives(monkeypatch):
+    """ISSUE 4 acceptance: 3/16 pids poisoned (maps bomb, perf-map bomb,
+    corrupt ELF); every window still ships all 16 pids' sample mass, the
+    3 land in quarantine, and they recover after probation once their
+    inputs heal."""
+    from parca_agent_tpu.aggregator.cpu import CPUAggregator
+    from parca_agent_tpu.capture.live import mapping_table_for_pids
+    from parca_agent_tpu.pprof.builder import build_pprof
+    from parca_agent_tpu.process.objectfile import ObjectFileCache
+    from parca_agent_tpu.runtime.quarantine import apply_ladder
+    from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+    from parca_agent_tpu.unwind.table import UnwindTableBuilder
+    from parca_agent_tpu.utils.fuzz import _sample_elf
+
+    monkeypatch.setattr(maps_mod, "_MAX_ROWS", 64)
+    monkeypatch.setattr(perfmap_mod, "_MAX_BYTES", 4096)
+
+    ALL = list(range(1, 17))
+    POISONED = [2, 5, 9]  # maps bomb / perf-map bomb / corrupt ELF
+
+    files = {}
+    for pid in ALL:
+        files[f"/proc/{pid}/maps"] = _good_maps(pid)
+        files[f"/proc/{pid}/status"] = b"NSpid:\t%d\n" % pid
+        files[f"/proc/{pid}/root/bin/app{pid}"] = _sample_elf()
+    files["/proc/2/maps"] = b"".join(
+        b"%x-%x r-xp 0 fd:01 2 /x\n" % (i * 0x1000, i * 0x1000 + 0x500)
+        for i in range(70))                       # > row cap
+    files["/proc/5/root/tmp/perf-5.map"] = b"a" * 5000   # > byte cap
+    files["/proc/9/root/bin/app9"] = b"\x7fELF" + b"\x02" * 20  # truncated
+    fs = FakeFS(files)
+
+    maps_cache = ProcessMapCache(fs=fs)
+    objs = ObjectFileCache(fs=fs)
+    reg = QuarantineRegistry(max_strikes=1, quarantine_windows=2,
+                             probation_windows=2, escalate_after=1,
+                             healthy_after_windows=3)
+    builder = UnwindTableBuilder(fs=fs, quarantine=reg)
+    sym = Symbolizer(perf=PerfMapCache(fs=fs), quarantine=reg)
+    agg = CPUAggregator()
+
+    def run_window():
+        """One ingest window over all 16 pids; returns pids shipped."""
+        table = mapping_table_for_pids(maps_cache, objs, ALL,
+                                       quarantine=reg)
+        for pid in ALL:
+            try:
+                ms = maps_cache.executable_mappings(pid)
+            except (OSError, PoisonInput):
+                continue
+            builder.table_for_pid(pid, ms)
+        profiles = agg.aggregate(_window_snapshot(ALL, table))
+        profiles = apply_ladder(profiles, reg)
+        sym.symbolize(profiles)
+        shipped = []
+        for prof in profiles:
+            blob = build_pprof(prof, compress=False)
+            assert blob  # every pid's mass ships — nothing is dropped
+            shipped.append(prof.pid)
+        reg.tick_window()
+        return shipped
+
+    # Poisoned phase: the bad pids trip within a few windows; EVERY
+    # window ships all 16 pids (zero whole-window losses).
+    for _ in range(4):
+        assert run_window() == ALL
+    assert reg.quarantined_pids() == POISONED
+    assert reg.stats["windows_salvaged_total"] >= 1
+    assert reg.stats["samples_degraded_total"] > 0
+    # The maps-bomb pid lost its mappings but its samples still shipped:
+    # the window count above already proves no profile was dropped.
+
+    # Baseline (drop-on-error) contrast: without a registry the same
+    # poisoned maps abort the whole table build — the reference behavior
+    # this PR deliberately deviates from (docs/robustness.md).
+    fresh = ProcessMapCache(fs=fs)
+    with pytest.raises(PoisonInput):
+        mapping_table_for_pids(fresh, objs, ALL, quarantine=None)
+
+    # Inputs heal: quarantine cooldowns expire, probation passes, the
+    # pids recover to full processing.
+    fs.put("/proc/2/maps", _good_maps(2))
+    fs.put("/proc/5/root/tmp/perf-5.map", b"5010 10 jit_ok\n")
+    fs.put("/proc/9/root/bin/app9", _sample_elf())
+    for _ in range(20):
+        assert run_window() == ALL
+        if not reg.quarantined_pids() and reg.counts()["probation"] == 0:
+            break
+    assert reg.quarantined_pids() == []
+    for pid in POISONED:
+        assert reg.level(pid) == 0
+    assert reg.stats["recoveries_total"] >= 3
+
+
+def test_map_caches_bound_the_read_itself(monkeypatch):
+    """The byte caps bound what is READ, not just what is parsed: a
+    multi-GB hostile file must cost at most cap+1 bytes of RSS."""
+
+    class HugeFS(FakeFS):
+        def open(self, path):
+            import io
+
+            class Infinite(io.RawIOBase):
+                def read(self, n=-1):
+                    assert n >= 0, "unbounded read of untrusted file"
+                    return b"a" * n
+
+                def readable(self):
+                    return True
+
+            return Infinite()
+
+    monkeypatch.setattr(perfmap_mod, "_MAX_BYTES", 4096)
+    monkeypatch.setattr(maps_mod, "_MAX_BYTES", 4096)
+    fs = HugeFS({"/proc/5/status": b"NSpid:\t5\n"})
+    with pytest.raises(PoisonInput):
+        PerfMapCache(fs=fs).map_for_pid(5)
+    with pytest.raises(PoisonInput):
+        ProcessMapCache(fs=fs).mappings_for_pid(5)
+
+
+def test_procfs_entry_address_contains_injected_elf_poison():
+    """elf.read poison inside the procfs entry-point probe must degrade
+    to the mapping-start fallback, not abort collect()."""
+    from parca_agent_tpu.capture.procfs import ProcfsSampler
+    from parca_agent_tpu.utils.fuzz import _sample_elf
+
+    fs = FakeFS({
+        "/proc/7/maps": b"1000-2000 r-xp 0 fd:01 9 /bin/a\n",
+        "/proc/7/root/bin/a": _sample_elf(),
+    })
+    faults.install(faults.FaultInjector.from_spec("elf.read:poison"))
+    snap = ProcfsSampler(fs=fs).collect({7: 10})
+    assert snap.pids.tolist() == [7]
+    assert int(snap.stacks[0, 0]) == 0x1000  # mapping-start fallback
+
+
+def test_procfs_sampler_contains_poisoned_maps(monkeypatch):
+    """A maps row-bomb under --capture procfs must cost that pid its
+    mappings, not the window: collect() still returns the other pids."""
+    from parca_agent_tpu.capture.procfs import ProcfsSampler
+
+    monkeypatch.setattr(maps_mod, "_MAX_ROWS", 4)
+    bomb = b"".join(b"%x-%x r-xp 0 fd:01 5 /x\n"
+                    % (0x1000 * i, 0x1000 * i + 0x500) for i in range(6))
+    fs = FakeFS({
+        "/proc/7/maps": b"1000-2000 r-xp 0 fd:01 9 /bin/a\n",
+        "/proc/8/maps": bomb,
+    })
+    reg = QuarantineRegistry(max_strikes=0)
+    s = ProcfsSampler(fs=fs)
+    s.quarantine = reg
+    snap = s.collect({7: 10, 8: 10})
+    assert 7 in snap.pids.tolist()      # healthy pid survives the window
+    assert reg.is_quarantined(8)
+
+
+def test_decay_needs_no_ship_receipt():
+    """An exited (or fast-encode-mode) pid must decay and be forgotten on
+    the window clock alone — no ship-success reporting exists or is
+    needed (an error-free window IS the clean signal)."""
+    reg = QuarantineRegistry(max_strikes=3, healthy_after_windows=2)
+    e = ValueError("x"); e.site = "maps.parse"
+    reg.record_error(7, "maps.parse", e)
+    for _ in range(5):
+        reg.tick_window()
+    assert reg.counts()["watched"] == 0  # forgotten: pid reuse is safe
+
+
+def test_registry_size_is_bounded():
+    reg = QuarantineRegistry(max_strikes=99)
+    reg._MAX_TRACKED = 8
+    e = ValueError("x"); e.site = "maps.parse"
+    for pid in range(20):
+        reg.record_error(pid, "maps.parse", e)
+    counts = reg.counts()
+    assert sum(counts.values()) <= 8
+
+
+def test_registry_churn_cannot_flush_incriminated_pids():
+    """A churn of one-error pids evicts its own kind, never a pid with
+    accumulated strikes — and with every slot quarantined, inserts are
+    refused rather than exceeding the bound."""
+    reg = QuarantineRegistry(max_strikes=99)
+    reg._MAX_TRACKED = 4
+    e = ValueError("x"); e.site = "maps.parse"
+    for _ in range(3):
+        reg.record_error(1, "maps.parse", e)   # pid 1: 3 strikes
+    for pid in range(100, 140):                # churn: 1 strike each
+        reg.record_error(pid, "maps.parse", e)
+    snap = reg.snapshot(limit=10)
+    assert snap["pids"]["1"]["strikes"] == 3   # survived the churn
+
+    reg2 = QuarantineRegistry(max_strikes=0)   # instant quarantine
+    reg2._MAX_TRACKED = 2
+    reg2.record_error(1, "maps.parse", e)
+    reg2.record_error(2, "maps.parse", e)
+    assert reg2.record_error(3, "maps.parse", e) == 0  # refused, level 0
+    assert sorted(reg2.quarantined_pids()) == [1, 2]
+    assert reg2.counts()["quarantined"] == 2   # bound held
+
+
+def test_elf_ingest_reads_are_bounded(monkeypatch):
+    """A PROT_EXEC-mapped multi-GB sparse file must cost at most the ELF
+    read cap — charged to the pid, never materialized."""
+    from parca_agent_tpu.process.objectfile import ObjectFileCache
+    from parca_agent_tpu.unwind.table import UnwindTableBuilder
+    from parca_agent_tpu.utils import poison as poison_mod
+    from parca_agent_tpu.utils.poison import OversizedInput, read_bounded
+
+    class BombFS(FakeFS):
+        def open(self, path):
+            import io
+
+            class Infinite(io.RawIOBase):
+                def read(self, n=-1):
+                    assert n >= 0, "unbounded read of untrusted ELF"
+                    return b"\x7fELF" + b"a" * (n - 4)
+
+                def readable(self):
+                    return True
+
+            return Infinite()
+
+        def stat_signature(self, path):
+            return (path, 0)
+
+    fs = BombFS()
+    with pytest.raises(OversizedInput):
+        read_bounded(fs, "/x", 4096, site="elf.read")
+
+    monkeypatch.setattr(poison_mod, "ELF_READ_CAP", 4096)
+    m = parse_proc_maps(b"1000-2000 r-xp 0 fd:01 9 /bin/bomb\n")[0]
+    reg = QuarantineRegistry(max_strikes=0)
+    # Object cache: degrades to None (fallback base), no OOM.
+    assert ObjectFileCache(fs=fs).get(7, m) is None
+    # Unwind builder: charged to the pid.
+    b = UnwindTableBuilder(fs=fs, quarantine=reg)
+    assert len(b.table_for_pid(7, [m])) == 0
+    assert reg.is_quarantined(7)
+
+
+def test_deadline_covers_unwind_build():
+    from parca_agent_tpu.unwind.table import UnwindTableBuilder
+    from parca_agent_tpu.utils.fuzz import _sample_elf
+
+    t = [0.0]
+    reg = QuarantineRegistry(max_strikes=0, deadline_s=0.5,
+                             clock=lambda: t[0])
+    fs = FakeFS({"/proc/7/root/bin/a": _sample_elf()})
+    m = parse_proc_maps(b"1000-2000 r-xp 0 fd:01 9 /bin/a\n")[0]
+
+    class SlowFS:
+        def read_bytes(self, path):
+            t[0] += 1.0  # the build "takes" a simulated second
+            return fs.read_bytes(path)
+
+        def open(self, path):
+            import io
+
+            return io.BytesIO(self.read_bytes(path))
+
+    builder = UnwindTableBuilder(fs=SlowFS(), quarantine=reg)
+    builder.table_for_pid(7, [m])
+    assert reg.is_quarantined(7)
+    assert reg.snapshot()["pids"]["7"]["last_site"] == "deadline"
+
+
+# -- mutation fuzz gate -------------------------------------------------------
+
+
+def test_fuzz_parsers_no_taxonomy_escapes():
+    """>=500 seeded mutations per parser (PARCA_FUZZ_N raises it; `make
+    fuzz` sets 500 explicitly); nothing may escape PoisonInput."""
+    from parca_agent_tpu.utils.fuzz import PARSERS, fuzz_parser
+
+    n = max(500, int(os.environ.get("PARCA_FUZZ_N", "500")))
+    seed = int(os.environ.get("PARCA_FAULT_SEED", "42"))
+    for name in PARSERS:
+        report = fuzz_parser(name, n=n, seed=seed)
+        assert report["mutations"] >= 500
+        assert report["escapes"] == [], (name, report["escapes"])
+
+
+def test_fuzz_is_deterministic_under_seed():
+    from parca_agent_tpu.utils.fuzz import fuzz_parser
+
+    a = fuzz_parser("eh_frame", n=100, seed=7)
+    b = fuzz_parser("eh_frame", n=100, seed=7)
+    assert (a["benign"], a["contained"]) == (b["benign"], b["contained"])
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

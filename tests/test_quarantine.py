@@ -1,0 +1,346 @@
+"""Quarantine registry lifecycle + degradation ladder tests.
+
+The ingest-containment acceptance bar (ISSUE 4, docs/robustness.md):
+trip → probation → recovery transitions, capped-exponential cooldown and
+ladder escalation across repeat trips, strike decay after sustained
+healthy windows, and — byte-for-byte — that a level-1 (addresses-only)
+profile is identical through the pprof builder to the same profile
+never locally symbolized, per the reference's server-side-symbolization
+contract (symbol.go:55-139).
+"""
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.aggregator.cpu import CPUAggregator
+from parca_agent_tpu.capture.formats import (
+    STACK_SLOTS,
+    MappingTable,
+    WindowSnapshot,
+)
+from parca_agent_tpu.pprof.builder import build_pprof, parse_pprof
+from parca_agent_tpu.runtime.quarantine import (
+    LEVEL_ADDRESSES,
+    LEVEL_FULL,
+    LEVEL_SCALAR,
+    QuarantineRegistry,
+    apply_ladder,
+    scalar_profile,
+)
+
+
+def _boom(site="maps.parse"):
+    e = ValueError("poisoned input")
+    e.site = site
+    return e
+
+
+def _trip(reg, pid, max_strikes=3):
+    for _ in range(max_strikes + 1):
+        reg.record_error(pid, "maps.parse", _boom())
+
+
+# -- registry lifecycle -------------------------------------------------------
+
+
+def test_strikes_within_budget_do_not_quarantine():
+    reg = QuarantineRegistry(max_strikes=3)
+    for _ in range(3):
+        assert reg.record_error(7, "maps.parse", _boom()) == LEVEL_FULL
+    assert not reg.is_quarantined(7)
+    assert reg.level(7) == LEVEL_FULL
+
+
+def test_trip_then_probation_then_recovery():
+    reg = QuarantineRegistry(max_strikes=2, quarantine_windows=3,
+                             probation_windows=2)
+    _trip(reg, 7, max_strikes=2)
+    assert reg.is_quarantined(7)
+    assert reg.level(7) == LEVEL_ADDRESSES
+    assert reg.quarantined_pids() == [7]
+    assert reg.stats["trips_total"] == 1
+
+    # Cooldown: 3 windows of quarantine.
+    for _ in range(3):
+        assert reg.is_quarantined(7)
+        reg.tick_window()
+    assert not reg.is_quarantined(7)
+    assert reg.level(7) == LEVEL_FULL  # probation = full processing
+
+    # Probation: 2 clean windows recover fully.
+    reg.tick_window()
+    reg.tick_window()
+    assert reg.level(7) == LEVEL_FULL
+    assert reg.stats["recoveries_total"] == 1
+    # Recovered: a single new error is a strike, not an instant re-trip.
+    assert reg.record_error(7, "maps.parse", _boom()) == LEVEL_FULL
+
+
+def test_probation_error_retrips_with_doubled_cooldown_and_escalates():
+    reg = QuarantineRegistry(max_strikes=1, quarantine_windows=2,
+                             probation_windows=1, escalate_after=2)
+    _trip(reg, 7, max_strikes=1)
+    assert reg.level(7) == LEVEL_ADDRESSES
+    for _ in range(2):
+        reg.tick_window()  # serve the 2-window cooldown
+    assert not reg.is_quarantined(7)
+
+    # Error during probation: instant re-trip, cooldown doubled (trip 2).
+    reg.record_error(7, "perfmap.parse", _boom("perfmap.parse"))
+    assert reg.is_quarantined(7)
+    assert reg.level(7) == LEVEL_ADDRESSES  # trips=2 <= escalate_after
+    for _ in range(4):  # 2 * 2^(2-1)
+        assert reg.is_quarantined(7)
+        reg.tick_window()
+    assert not reg.is_quarantined(7)
+
+    # Third trip escalates past escalate_after: scalar level.
+    reg.record_error(7, "perfmap.parse", _boom("perfmap.parse"))
+    assert reg.level(7) == LEVEL_SCALAR
+
+
+def test_sustained_healthy_run_decays_strikes():
+    reg = QuarantineRegistry(max_strikes=2, healthy_after_windows=3)
+    reg.record_error(7, "maps.parse", _boom())
+    reg.record_error(7, "maps.parse", _boom())  # 2 strikes, budget edge
+    reg.tick_window()
+    for _ in range(4):  # clean-window credit comes from ticks alone
+        reg.tick_window()
+    # Budget refreshed: two more strikes don't trip.
+    reg.record_error(7, "maps.parse", _boom())
+    reg.record_error(7, "maps.parse", _boom())
+    assert not reg.is_quarantined(7)
+
+
+def test_unwatched_clean_pids_are_forgotten():
+    reg = QuarantineRegistry(max_strikes=2, healthy_after_windows=2)
+    reg.record_error(7, "maps.parse", _boom())
+    for _ in range(8):
+        reg.tick_window()
+    assert reg.counts() == {"quarantined": 0, "probation": 0, "watched": 0,
+                            "level_addresses": 0, "level_scalar": 0}
+
+
+def test_deadline_overrun_counts_as_fault():
+    t = [0.0]
+    reg = QuarantineRegistry(max_strikes=1, deadline_s=0.5,
+                             clock=lambda: t[0])
+    t0 = reg.clock()
+    t[0] = 1.0
+    reg.check_deadline(7, t0)
+    t0 = reg.clock()
+    t[0] = 2.0
+    reg.check_deadline(7, t0)
+    assert reg.is_quarantined(7)
+    assert reg.stats["deadline_trips_total"] == 2
+    snap = reg.snapshot()
+    assert snap["pids"]["7"]["last_site"] == "deadline"
+
+
+def test_snapshot_shape_and_counts():
+    reg = QuarantineRegistry(max_strikes=1)
+    _trip(reg, 3, max_strikes=1)
+    reg.record_error(9, "elf.read", _boom("elf.read"))
+    c = reg.counts()
+    assert c["quarantined"] == 1 and c["watched"] == 1
+    snap = reg.snapshot()
+    assert snap["pids"]["3"]["state"] == "quarantined"
+    assert snap["pids"]["3"]["level"] == "addresses"
+    assert snap["stats"]["trips_total"] == 1
+
+
+def test_windows_salvaged_counts_only_quarantined_windows():
+    reg = QuarantineRegistry(max_strikes=1, quarantine_windows=2)
+    reg.tick_window()
+    assert reg.stats["windows_salvaged_total"] == 0
+    _trip(reg, 7, max_strikes=1)
+    reg.tick_window()
+    reg.tick_window()
+    assert reg.stats["windows_salvaged_total"] == 2
+
+
+# -- degradation ladder -------------------------------------------------------
+
+
+def _profiles():
+    stacks = np.zeros((3, STACK_SLOTS), np.uint64)
+    stacks[0, :2] = [0x1100, 0x2200]
+    stacks[1, :2] = [0x1100, 0x2300]
+    stacks[2, :2] = [0x9100, 0x9200]
+    table = MappingTable(
+        pids=[7, 9],
+        starts=[0x1000, 0x9000],
+        ends=[0x3000, 0xA000],
+        offsets=[0x100, 0],
+        objs=[0, 0],
+        obj_paths=("/bin/a",),
+        obj_buildids=("aa" * 20,),
+    )
+    snap = WindowSnapshot(
+        pids=[7, 7, 9], tids=[7, 7, 9], counts=[3, 4, 5],
+        user_len=[2, 2, 2], kernel_len=[0, 0, 0],
+        stacks=stacks, mappings=table,
+    )
+    return CPUAggregator().aggregate(snap)
+
+
+def test_ladder_level1_is_byte_identical_to_unsymbolized():
+    reg = QuarantineRegistry(max_strikes=0, escalate_after=9)
+    reg.record_error(7, "elf.read", _boom("elf.read"))  # instant trip
+    assert reg.level(7) == LEVEL_ADDRESSES
+
+    plain = _profiles()
+    reference = build_pprof(plain[0], compress=False)
+
+    laddered = _profiles()
+    # Simulate a prior (now poisoned) local symbolization artifact that
+    # the ladder must strip.
+    laddered[0].functions = [("stale", "stale", "", 0)]
+    laddered[0].loc_lines = [[(1, 0)] for _ in range(laddered[0].n_locations)]
+    out = apply_ladder(laddered, reg)
+    assert len(out) == 2  # never drops a profile
+    assert build_pprof(out[0], compress=False) == reference
+    # Healthy pid untouched.
+    assert out[1] is laddered[1]
+    assert reg.stats["samples_degraded_total"] == 7
+
+
+def test_ladder_level2_scalar_preserves_total_through_builder():
+    reg = QuarantineRegistry(max_strikes=0, escalate_after=0)
+    reg.record_error(9, "maps.parse", _boom())
+    assert reg.level(9) == LEVEL_SCALAR
+
+    profs = _profiles()
+    out = apply_ladder(profs, reg)
+    scalar = [p for p in out if p.pid == 9][0]
+    scalar.check()
+    parsed = parse_pprof(build_pprof(scalar, compress=False))
+    assert sum(vals[0] for _, vals, _ in parsed.samples) == 5
+    assert len(parsed.samples) == 1
+    assert parsed.mappings == {}
+
+
+def test_scalar_profile_carries_window_metadata():
+    prof = _profiles()[0]
+    s = scalar_profile(prof)
+    assert (s.period_ns, s.time_ns, s.duration_ns) == \
+        (prof.period_ns, prof.time_ns, prof.duration_ns)
+    assert s.total() == prof.total()
+
+
+def test_apply_ladder_without_registry_is_identity():
+    profs = _profiles()
+    assert apply_ladder(profs, None) == profs
+
+
+# -- symbolizer integration ---------------------------------------------------
+
+
+def test_symbolizer_skips_laddered_pids():
+    from parca_agent_tpu.symbolize.ksym import KsymCache
+    from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+    from parca_agent_tpu.utils.vfs import FakeFS
+
+    fs = FakeFS({"/proc/kallsyms":
+                 b"ffffffff81000000 T kfunc_a\n"
+                 b"ffffffff81000100 T kfunc_b\n"})
+    profs = _profiles()
+    # Give pid 7 a kernel frame so symbolization would touch it.
+    profs[0].loc_is_kernel[:] = True
+    profs[0].loc_address[:] = 0xFFFFFFFF81000000
+
+    reg = QuarantineRegistry(max_strikes=0)
+    reg.record_error(7, "elf.read", _boom("elf.read"))
+    sym = Symbolizer(ksym=KsymCache(fs=fs), quarantine=reg)
+    sym.symbolize(profs)
+    assert profs[0].loc_lines is None      # skipped: ships addresses-only
+    assert profs[0].functions == []
+
+
+def test_symbolizer_kernel_guard_records_last_errors():
+    """Satellite: a corrupt kallsyms cache must cost the window its
+    kernel names, not the whole symbolization pass."""
+    from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+
+    class BoomKsym:
+        def resolve(self, addrs):
+            raise RuntimeError("corrupt kallsyms cache")
+
+    profs = _profiles()
+    profs[0].loc_is_kernel[:] = True
+    sym = Symbolizer(ksym=BoomKsym())
+    sym.symbolize(profs)  # must not raise
+    assert 7 in sym.last_errors
+    assert isinstance(sym.last_errors[7], RuntimeError)
+
+
+def test_profiler_ladder_and_tick_in_iteration():
+    """End-to-end through CPUProfiler.run_iteration: a quarantined pid's
+    profile ships degraded, the window still ships, and the registry's
+    window clock advances."""
+    from parca_agent_tpu.profiler.cpu import CPUProfiler
+
+    reg = QuarantineRegistry(max_strikes=0, quarantine_windows=2,
+                             escalate_after=0)
+    reg.record_error(9, "maps.parse", _boom())
+    assert reg.level(9) == LEVEL_SCALAR
+
+    stacks = np.zeros((2, STACK_SLOTS), np.uint64)
+    stacks[0, :2] = [0x1100, 0x2200]
+    stacks[1, :2] = [0x9100, 0x9200]
+    snap = WindowSnapshot(
+        pids=[7, 9], tids=[7, 9], counts=[3, 5],
+        user_len=[2, 2], kernel_len=[0, 0],
+        stacks=stacks, mappings=MappingTable.empty(),
+    )
+
+    written = []
+
+    class Writer:
+        def write(self, labels, blob):
+            written.append((labels["pid"], blob))
+
+    class Source:
+        def __init__(self):
+            self.snaps = [snap]
+
+        def poll(self):
+            return self.snaps.pop() if self.snaps else None
+
+    prof = CPUProfiler(source=Source(), aggregator=CPUAggregator(),
+                       profile_writer=Writer(), quarantine=reg)
+    assert prof.run_iteration() is True
+    assert sorted(p for p, _ in written) == ["7", "9"]
+    parsed9 = parse_pprof([b for p, b in written if p == "9"][0])
+    assert sum(vals[0] for _, vals, _ in parsed9.samples) == 5
+    assert len(parsed9.samples) == 1  # scalar-collapsed
+    parsed7 = parse_pprof([b for p, b in written if p == "7"][0])
+    # Healthy pid: the full 2-frame stack travels (the scalar collapse
+    # would have left one depth-1 sample at address 0).
+    assert parsed7.samples[0][0] == (1, 2)
+    assert {loc["address"] for loc in parsed7.locations.values()} == \
+        {0x1100, 0x2200}
+    # tick_window ran: one quarantine window served.
+    assert reg.stats["windows_salvaged_total"] == 1
+
+
+def test_metrics_render_quarantine_gauges():
+    from parca_agent_tpu.web import render_metrics
+
+    reg = QuarantineRegistry(max_strikes=0)
+    reg.record_error(3, "elf.read", _boom("elf.read"))
+    text = render_metrics([], quarantine=reg)
+    assert 'parca_agent_quarantine_pids{state="quarantined"} 1' in text
+    assert 'parca_agent_quarantine_ladder_pids{level="addresses"} 1' in text
+    assert "parca_agent_quarantine_trips_total 1" in text
+    assert "parca_agent_quarantine_samples_degraded_total 0" in text
+    # State and ladder metrics each sum to the true pid count (no
+    # double counting across the two).
+    state_total = sum(
+        int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+        if line.startswith("parca_agent_quarantine_pids{"))
+    assert state_total == 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
