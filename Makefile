@@ -23,10 +23,10 @@ test-live:
 	$(PYTHON) -m pytest tests/ -q -m live
 
 # Fault-injection suite under a fixed seed (docs/robustness.md): store
-# outages, disk-full spill, actor crashes — deterministic by design, so
-# it also rides every unmarked run.
+# outages, disk-full spill, actor crashes, device/fleet hangs —
+# deterministic by design, so it also rides every unmarked run.
 chaos:
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the

@@ -262,6 +262,21 @@ def _window_kernel(
     )
 
 
+def shadow_compare(device_profiles, cpu_profiles) -> bool:
+    """A/B correctness gate between two aggregations of the SAME window
+    (the device-health registry's shadow-window promotion check,
+    runtime/device_health.py — the same invariants the bench's A/B
+    phases assert): per pid, total sample mass and unique-stack count
+    must agree, order-insensitively. A backend that answers promptly but
+    WRONGLY (a half-reset dict table after a wedge, a corrupted transfer)
+    fails here and stays demoted."""
+    def digest(profiles):
+        return {int(p.pid): (int(p.total()), int(len(p.values)))
+                for p in profiles}
+
+    return digest(device_profiles) == digest(cpu_profiles)
+
+
 def pack_window_inputs(snapshot: WindowSnapshot, l_cap: int | None = None):
     """Pad a WindowSnapshot into the kernel's uint32 operand layout.
 

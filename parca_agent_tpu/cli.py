@@ -175,6 +175,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="total agent processes in the fleet")
     p.add_argument("--fleet-node-id", type=int, default=-1,
                    help="this agent's rank (0 = coordinator)")
+    p.add_argument("--fleet-join-timeout", type=float, default=60.0,
+                   help="seconds the fleet join (jax.distributed "
+                        "initialize) may take before it is abandoned and "
+                        "the agent continues SINGLE-NODE (a dead "
+                        "coordinator used to block startup forever); "
+                        "0 = unbounded")
+    p.add_argument("--collective-timeout", type=float, default=30.0,
+                   help="seconds one fleet merge collective may take "
+                        "before it is abandoned and fleet mode degrades "
+                        "to node-local profiles (counted, rejoin after a "
+                        "bounded re-probe — a hung peer must not wedge "
+                        "this node's merge actor); 0 = unbounded")
+    p.add_argument("--device-probe-timeout", type=float, default=60.0,
+                   help="device-health: hard deadline for one "
+                        "subprocess-isolated backend probe (the probe "
+                        "child is KILLED past it — a wedged backend init "
+                        "cannot be cancelled from a thread); probes gate "
+                        "bring-up and re-promotion after a demotion "
+                        "(docs/robustness.md). 0 disables probing "
+                        "(optimistic bring-up, shadow-window gate only)")
+    p.add_argument("--device-promote-after", type=int, default=2,
+                   help="device-health: consecutive healthy probes "
+                        "required before the shadow window that gates "
+                        "promotion back from the CPU fallback to the "
+                        "device")
     p.add_argument("--capture", default="perf",
                    choices=["perf", "procfs", "synthetic", "replay"],
                    help="capture source: perf (native perf_event sampler, "
@@ -270,8 +295,19 @@ def run(argv=None) -> int:
             return 2
         from parca_agent_tpu.parallel.distributed import fleet_initialize
 
-        fleet_initialize(args.fleet_coordinator, args.fleet_nodes,
-                         args.fleet_node_id)
+        try:
+            fleet_initialize(args.fleet_coordinator, args.fleet_nodes,
+                             args.fleet_node_id,
+                             timeout_s=args.fleet_join_timeout or None)
+        except Exception as e:  # noqa: BLE001 - degrade, don't crash
+            # A dead/refusing coordinator must not kill the agent at
+            # startup: this host still deserves its profiler. Continue
+            # single-node — the per-node gRPC upload (the loss-tolerant
+            # channel) is untouched; only the fleet-wide merge gauges
+            # are forfeited until a restart rejoins.
+            log.error("fleet join failed; continuing single-node",
+                      coordinator=args.fleet_coordinator, error=repr(e))
+            args.fleet_coordinator = ""
 
     from parca_agent_tpu.agent.batch import BatchWriteClient, NoopStoreClient
     from parca_agent_tpu.agent.listener import MatchingProfileListener
@@ -395,6 +431,32 @@ def run(argv=None) -> int:
     else:
         aggregator = CPUAggregator()
 
+    # -- device-runtime health (docs/robustness.md "device & fleet
+    # health") ---------------------------------------------------------------
+    # Any config with a device backend (fallback != None) gets the
+    # demote/promote registry: bring-up is a KILLED-on-deadline
+    # subprocess probe (a wedged backend init hangs inside a C call —
+    # BENCH_r05 measured >420 s of it — and only a child process can be
+    # killed), the capture loop runs on the CPU fallback until the probe
+    # lands, and a mid-run hang demotes with capped-backoff re-probes +
+    # a shadow-window correctness gate before promotion.
+    device_health = None
+    if fallback is not None:
+        from parca_agent_tpu.runtime.device_health import (
+            DeviceHealthRegistry,
+            subprocess_probe,
+        )
+
+        probe = None
+        if args.device_probe_timeout > 0:
+            probe = (lambda t=args.device_probe_timeout:
+                     subprocess_probe(t))
+        device_health = DeviceHealthRegistry(
+            probe=probe,
+            probe_timeout_s=args.device_probe_timeout,
+            promote_after=args.device_promote_after)
+        device_health.start()
+
     # -- transport -----------------------------------------------------------
     if args.remote_store_address:
         from parca_agent_tpu.agent.grpc_client import GRPCStoreClient
@@ -510,7 +572,9 @@ def run(argv=None) -> int:
         from parca_agent_tpu.ops.hashing import row_hash_np
         from parca_agent_tpu.parallel.distributed import FleetWindowMerger
 
-        fleet_merger = FleetWindowMerger(interval_s=args.profiling_duration)
+        fleet_merger = FleetWindowMerger(
+            interval_s=args.profiling_duration,
+            collective_timeout_s=args.collective_timeout or None)
 
         def window_sink(snapshot):
             # Hashing runs lazily on the fleet actor's thread, keeping
@@ -597,6 +661,7 @@ def run(argv=None) -> int:
         encode_pipeline=args.fast_encode and not args.no_encode_pipeline,
         encode_deadline_s=args.encode_deadline or None,
         quarantine=quarantine,
+        device_health=device_health,
     )
 
     # -- supervision ---------------------------------------------------------
@@ -642,6 +707,11 @@ def run(argv=None) -> int:
                     out[f"parca_agent_streaming_{k}"] = round(v, 4) \
                         if isinstance(v, float) else v
         if fleet_merger is not None:
+            # Degrade/rejoin accounting (collective-timeout path): how
+            # many merge rounds ran node-local-only, timeouts, rejoins.
+            out["parca_agent_fleet_degraded"] = int(fleet_merger.degraded)
+            for k, v in fleet_merger.stats.items():
+                out[f"parca_agent_fleet_{k}"] = v
             if fleet_merger.failed is not None:
                 # Fleet mode is dead (SPMD peer loss): surface THAT, not
                 # plausible frozen last-good gauges.
@@ -682,7 +752,8 @@ def run(argv=None) -> int:
                            listener=listener, version=binfo.display(),
                            extra_metrics=capture_metrics,
                            capture_info=capture_metrics,
-                           supervisor=sup, quarantine=quarantine)
+                           supervisor=sup, quarantine=quarantine,
+                           device_health=device_health)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None
@@ -703,6 +774,18 @@ def run(argv=None) -> int:
     if fleet_merger is not None:
         sup.add_actor("fleet", run=lambda: fleet_merger.run(stop),
                       stop=stop.set, critical=False)
+        # Heartbeat: a PEER hang can leave the merge actor blocked with
+        # its thread healthy; the probe surfaces the stall on /healthz
+        # and (when degraded) pulls the next rejoin probe forward.
+        sup.add_probe("fleet-heartbeat", check=fleet_merger.heartbeat,
+                      revive=fleet_merger.request_rejoin, critical=False)
+    if device_health is not None:
+        # Demote/promote supervision joins the run-group: the registry
+        # drives itself on the window clock; the probe only surfaces a
+        # DEAD backend (re-probe budget exhausted) as a degraded actor.
+        sup.add_probe("device",
+                      check=lambda: device_health.state != "dead",
+                      critical=False)
     if profiler._pipeline is not None:
         # The encode pipeline owns its worker thread; supervise it as a
         # probe — a worker death disables the pipeline, the probe revives

@@ -21,6 +21,8 @@ results as host numpy.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from parca_agent_tpu.parallel.fleet import (
@@ -30,39 +32,79 @@ from parca_agent_tpu.parallel.fleet import (
     _sketch_program,
 )
 from parca_agent_tpu.parallel.mesh import FLEET_AXIS
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
 log = get_logger("fleet")
 
 
+class FleetJoinError(RuntimeError):
+    """Bounded fleet join failed: the coordinator refused, or the join
+    did not complete within its deadline and was abandoned. The agent
+    can (and should) continue single-node."""
+
+
+class CollectiveTimeout(RuntimeError):
+    """A fleet collective exceeded its deadline and was abandoned (a
+    lost/hung peer leaves every other node blocked inside the program —
+    jax.distributed offers no per-collective timeout of its own)."""
+
+
 def fleet_initialize(coordinator_address: str, num_nodes: int,
-                     node_id: int) -> None:
+                     node_id: int, timeout_s: float | None = None) -> None:
     """Join the fleet process group. Call once, before any device work.
 
     On the CPU backend each process is pinned to one local device first:
     the mesh convention is one position per agent, and an uninitialized
-    CPU backend would otherwise expose one device per core."""
-    import jax
+    CPU backend would otherwise expose one device per core.
 
-    # NOTE: nothing backend-touching may run before initialize() — even
-    # jax.process_count() would initialize XLA; is_initialized() is the
-    # one safe idempotence probe.
-    if jax.distributed.is_initialized():
-        return
-    try:
-        # On the CPU backend (dev fleets, tests) an uninitialized process
-        # would otherwise expose one device per core; on TPU the setting
-        # is ignored. Must happen before backend init.
-        jax.config.update("jax_num_cpu_devices", 1)
-    except Exception:  # noqa: BLE001 - backend already initialized
-        pass
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_nodes,
-        process_id=node_id,
-    )
-    log.info("fleet initialized", nodes=jax.process_count(),
-             node_id=node_id, devices=len(jax.devices()))
+    With ``timeout_s`` the join runs on an abandonable daemon thread: a
+    dead coordinator used to block here FOREVER (the bring-up twin of
+    the bench's >420 s backend-init hangs); past the deadline a
+    :class:`FleetJoinError` is raised so the caller can degrade to
+    single-node mode. The abandoned thread may still complete the join
+    in the background — callers that degraded must not assume the
+    process group stays uninitialized."""
+
+    def join():
+        import jax
+
+        # Chaos first: an injected fleet.join hang/refusal must fire
+        # before any backend touch, so the bounded-join machinery is
+        # testable without wedging the test process's jax config.
+        faults.inject("fleet.join")
+        # NOTE: nothing backend-touching may run before initialize() —
+        # even jax.process_count() would initialize XLA;
+        # is_initialized() is the one safe idempotence probe.
+        if jax.distributed.is_initialized():
+            return
+        try:
+            # On the CPU backend (dev fleets, tests) an uninitialized
+            # process would otherwise expose one device per core; on TPU
+            # the setting is ignored. Must happen before backend init.
+            jax.config.update("jax_num_cpu_devices", 1)
+        except Exception:  # noqa: BLE001 - backend already initialized
+            pass
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_nodes,
+            process_id=node_id,
+        )
+        log.info("fleet initialized", nodes=jax.process_count(),
+                 node_id=node_id, devices=len(jax.devices()))
+
+    if timeout_s is None:
+        return join()
+    from parca_agent_tpu.utils.bounded import bounded_call
+
+    status, out, _, _ = bounded_call(join, timeout_s,
+                                     thread_name="fleet-join")
+    if status == "hang":
+        raise FleetJoinError(
+            f"fleet join did not complete within {timeout_s:.0f}s "
+            f"(coordinator {coordinator_address}); abandoned")
+    if status == "err":
+        raise FleetJoinError(f"fleet join failed: {out!r}") from out
 
 
 def local_fleet_mesh():
@@ -180,30 +222,53 @@ class FleetWindowMerger:
     SPMD discipline: collectives are a fixed program order all processes
     must enter together, so a round NEVER skips — a node with no fresh
     window contributes a zero-count stream (the identity of every
-    reduction used). A failure inside the collective is fatal to fleet
-    mode on every node at once (jax.distributed is SPMD; a lost process
-    means restart the fleet — the loss-tolerant channel to the Parca
-    server remains each node's own gRPC upload, exactly the reference's
-    transport). Results land in `fleet_stats` for /metrics:
-    fleet_total_samples, fleet_unique_stacks, fleet_rounds.
+    reduction used). A lost or hung PEER therefore leaves this node
+    blocked inside the program; with ``collective_timeout_s`` set, every
+    round runs on an abandonable daemon thread and a blown deadline
+    DEGRADES fleet mode instead of wedging the actor: node-local
+    profiles keep shipping through the agent's own gRPC upload (the
+    loss-tolerant channel, exactly the reference's transport), the
+    skipped merge rounds are COUNTED (``local_only_rounds``), and after
+    ``rejoin_after_rounds`` rounds (doubling per failed attempt, capped)
+    the merger re-probes with one tiny bounded collective and rejoins
+    the schedule when it completes — SURVEY §5.3's missing-node
+    tolerance, made operational. Results land in `fleet_stats` for
+    /metrics: fleet_total_samples, fleet_unique_stacks, fleet_rounds.
     """
 
-    def __init__(self, interval_s: float = 10.0):
-        import threading
+    def __init__(self, interval_s: float = 10.0,
+                 collective_timeout_s: float | None = None,
+                 rejoin_after_rounds: int = 6,
+                 max_rejoin_after_rounds: int = 96):
         import time as _time
 
         self._interval = interval_s
+        self._collective_timeout = collective_timeout_s
         self._lock = threading.Lock()
         self._window = None  # (hashes, counts) of the latest closed window
         self.fleet_stats: dict = {}
         self.failed: Exception | None = None
         self._clock = _time.monotonic
+        # Degrade/rejoin state (collective timeout path).
+        self.degraded = False
+        self._rejoin_base = max(1, rejoin_after_rounds)
+        self._rejoin_max = max(self._rejoin_base, max_rejoin_after_rounds)
+        self._rejoin_backoff = self._rejoin_base
+        self._rejoin_in = 0
+        self._inflight = None  # Event of the abandoned collective
+        self.stats = {
+            "collective_timeouts": 0,
+            "local_only_rounds": 0,
+            "rejoins": 0,
+            "rejoin_probes_failed": 0,
+        }
+        self.last_degrade_error: str = ""
         # Hang observability: a PEER's failure leaves this node blocked
         # inside the next collective with failed=None and frozen last-good
         # gauges. These two clocks make that state visible from /metrics
         # (round age beyond ~2x the interval, or an in-flight round older
-        # than the interval, means the fleet schedule has stalled —
-        # jax.distributed offers no per-collective timeout to bound it).
+        # than the interval, means the fleet schedule has stalled; with
+        # no collective timeout configured they are the ONLY signal).
         self.last_round_at: float | None = None
         self.round_started_at: float | None = None
 
@@ -215,18 +280,36 @@ class FleetWindowMerger:
         with self._lock:
             self._window = (hashes, np.ascontiguousarray(counts, np.int32))
 
-    def merge_round(self) -> None:
-        self.round_started_at = self._clock()
-        with self._lock:
-            win, self._window = self._window, None
-        if win is None:
-            h1 = h2 = np.zeros(0, np.uint32)
-            counts = np.zeros(0, np.int32)
-        else:
-            hashes, counts = win
-            h1, h2 = hashes() if callable(hashes) else hashes
-            h1 = np.ascontiguousarray(h1, np.uint32)
-            h2 = np.ascontiguousarray(h2, np.uint32)
+    def _bounded(self, thunk):
+        """Run one collective program under the abandonable bounded-call
+        guard (utils/bounded.py — the profiler's device watchdog,
+        applied to the fleet): past the deadline the thread is abandoned
+        — it may still be blocked inside the collective, so nothing
+        re-enters the schedule until its event fires — and
+        CollectiveTimeout raises to the caller."""
+        if self._collective_timeout is None:
+            return thunk()
+        from parca_agent_tpu.utils.bounded import bounded_call
+
+        status, out, done, _ = bounded_call(
+            thunk, self._collective_timeout,
+            thread_name="fleet-collective")
+        if status == "hang":
+            self._inflight = done
+            raise CollectiveTimeout(
+                f"fleet collective exceeded {self._collective_timeout}s; "
+                "abandoned")
+        if status == "err":
+            raise out
+        return out
+
+    def _inflight_clear(self) -> bool:
+        return self._inflight is None or self._inflight.is_set()
+
+    def _merge_collective(self, h1, h2, counts):
+        """The full merge round's collective program (width agreement is
+        itself a collective, so it rides the bounded thunk too)."""
+        faults.inject("fleet.collective")
         width = _agree_width(len(h1))
         ph1 = np.zeros(width, np.uint32)
         ph2 = np.zeros(width, np.uint32)
@@ -239,8 +322,56 @@ class FleetWindowMerger:
         # count; the sketch merge would add a second cross-host program
         # for no extra information (sketches remain the offline/bounded
         # artifact, parallel/fleet.py).
-        u1, _, uc = fleet_merge_exact64_dist(ph1, ph2, pc,
-                                             local_fleet_mesh())
+        return fleet_merge_exact64_dist(ph1, ph2, pc, local_fleet_mesh())
+
+    def _probe_collective(self) -> None:
+        """Rejoin probe: one tiny allgather under the same bound, with an
+        EPOCH-agreement check. The degrade state machine is itself
+        lockstep-SPMD — a hung peer stalls the SAME round on every
+        surviving node, so all degrade together and count rounds on the
+        same interval cadence — and every node gathers its round epoch
+        here: equal epochs across the gather is the mechanical evidence
+        that this allgather paired with the PEERS' probes, not with some
+        differently-paced node's mid-merge collective (an unmatched
+        pairing would permanently offset the program order). Any
+        disagreement = the schedule is not re-aligned: stay degraded and
+        back off. A peer that died outright never answers — the bound
+        expires and the merger stays node-local (true recovery from
+        process loss requires restarting the fleet; jax.distributed
+        cannot re-admit a process)."""
+        faults.inject("fleet.collective")
+        from jax.experimental import multihost_utils
+
+        epoch = (self.stats["local_only_rounds"]
+                 + self.fleet_stats.get("fleet_rounds", 0))
+        out = np.asarray(multihost_utils.process_allgather(
+            np.asarray([epoch], np.int64), tiled=True)).ravel()
+        if out.size == 0 or not (out == out[0]).all():
+            raise RuntimeError(
+                f"rejoin probe epoch mismatch {out.tolist()}: the fleet "
+                "schedule is not re-aligned")
+
+    def merge_round(self) -> None:
+        if self.degraded:
+            self._degraded_round()
+            return
+        self.round_started_at = self._clock()
+        with self._lock:
+            win, self._window = self._window, None
+        if win is None:
+            h1 = h2 = np.zeros(0, np.uint32)
+            counts = np.zeros(0, np.int32)
+        else:
+            hashes, counts = win
+            h1, h2 = hashes() if callable(hashes) else hashes
+            h1 = np.ascontiguousarray(h1, np.uint32)
+            h2 = np.ascontiguousarray(h2, np.uint32)
+        try:
+            u1, _, uc = self._bounded(
+                lambda: self._merge_collective(h1, h2, counts))
+        except Exception as e:  # noqa: BLE001 - degrade, never wedge
+            self._degrade(e)
+            return
         self.fleet_stats = {
             "fleet_total_samples": int(uc.astype(np.int64).sum()),
             "fleet_unique_stacks": int(len(u1)),
@@ -249,12 +380,83 @@ class FleetWindowMerger:
         self.last_round_at = self._clock()
         self.round_started_at = None
 
+    def _degrade(self, e: Exception) -> None:
+        self.degraded = True
+        if isinstance(e, CollectiveTimeout):
+            self.stats["collective_timeouts"] += 1
+        self.last_degrade_error = repr(e)[:200]
+        self._rejoin_backoff = self._rejoin_base
+        self._rejoin_in = self._rejoin_backoff
+        self.round_started_at = None
+        log.error("fleet collective hung/failed; degrading to node-local "
+                  "profiles (each node's own gRPC upload keeps shipping; "
+                  "merge rounds are counted, rejoin after re-probe)",
+                  error=self.last_degrade_error,
+                  rejoin_after_rounds=self._rejoin_in)
+
+    def _degraded_round(self) -> None:
+        """One round in degraded mode: the window's fleet contribution is
+        skipped (counted — the profiles themselves already shipped via
+        this node's writer), and on schedule a bounded re-probe attempts
+        the rejoin."""
+        with self._lock:
+            self._window = None  # this round's contribution is forfeited
+        self.stats["local_only_rounds"] += 1
+        self._rejoin_in -= 1
+        if self._rejoin_in > 0:
+            return
+        if not self._inflight_clear():
+            # The abandoned collective is STILL blocked inside the
+            # schedule; probing now would race it. Check again next round.
+            self._rejoin_in = 1
+            return
+        try:
+            self._bounded(self._probe_collective)
+        except Exception as e:  # noqa: BLE001 - stay degraded, backoff
+            self.stats["rejoin_probes_failed"] += 1
+            self._rejoin_backoff = min(self._rejoin_backoff * 2,
+                                       self._rejoin_max)
+            self._rejoin_in = self._rejoin_backoff
+            log.warn("fleet rejoin probe failed; staying node-local",
+                     error=repr(e)[:200],
+                     next_probe_rounds=self._rejoin_in)
+            return
+        self.degraded = False
+        self._rejoin_backoff = self._rejoin_base
+        self.stats["rejoins"] += 1
+        self.last_round_at = self._clock()
+        log.info("fleet rejoin probe ok; re-entering the merge schedule")
+
+    # -- supervision hooks ----------------------------------------------------
+
+    def heartbeat(self) -> bool:
+        """Supervisor probe hook: False when the fleet schedule looks
+        stalled — an in-flight round older than its bound (with a
+        collective timeout configured a round cannot stall, so this only
+        trips on the unbounded config) or fleet mode terminally failed."""
+        if self.failed is not None:
+            return False
+        started = self.round_started_at
+        if started is None:
+            return True
+        bound = max(self._interval,
+                    self._collective_timeout or 0.0) * 2 + self._interval
+        return self._clock() - started <= bound
+
+    def request_rejoin(self) -> None:
+        """Supervisor revive hook: pull the next rejoin probe forward to
+        the next round."""
+        if self.degraded:
+            self._rejoin_in = min(self._rejoin_in, 1)
+
     def run(self, stop) -> None:
         """Actor loop (threading.Event stop)."""
         while not stop.is_set():
             try:
                 self.merge_round()
             except Exception as e:  # noqa: BLE001 - SPMD schedule broken
+                # merge_round degrades on collective trouble; anything
+                # escaping it is a bug in the degrade path itself.
                 self.failed = e
                 log.error("fleet merge failed; fleet mode disabled",
                           error=repr(e))

@@ -53,6 +53,12 @@ class ProfilerMetrics:
     last_encode_duration_s: float = 0.0
     encode_backpressure_total: int = 0
     encode_deadline_hits_total: int = 0
+    # Abandoned-device-call accounting: how many watchdogged calls that
+    # were abandoned at their deadline eventually RETURNED, and how they
+    # ended. An abandoned call that later fails used to set box["err"]
+    # into the void — now it is logged and counted here.
+    device_abandoned_ok_total: int = 0
+    device_abandoned_err_total: int = 0
 
 
 class CPUProfiler:
@@ -78,6 +84,7 @@ class CPUProfiler:
         encode_pipeline: bool = False,
         encode_deadline_s: float | None = None,
         quarantine=None,
+        device_health=None,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -164,11 +171,29 @@ class CPUProfiler:
         self._feeder = streaming_feeder
         self._fallback = fallback_aggregator
         self._device_timeout = device_timeout_s
-        self._device_retry_windows = device_retry_windows
-        # Hang containment state: the in-flight aggregation call when the
-        # device last wedged, and the window count at which it did.
+        # Device lifecycle state lives in ONE place: the health registry
+        # (runtime/device_health.py) owns wedge accounting, cooldowns,
+        # the probing/healthy/degraded/dead machine, and the shadow-
+        # window promotion gate. The CLI passes a probe-armed registry;
+        # embedders get a probe-less default that reproduces the old
+        # retry-after-N-windows semantics (cooldown expiry goes straight
+        # to the shadow window).
+        self._health = device_health
+        if self._health is None and fallback_aggregator is not None:
+            from parca_agent_tpu.runtime.device_health import (
+                STATE_HEALTHY,
+                DeviceHealthRegistry,
+            )
+
+            self._health = DeviceHealthRegistry(
+                probe=None, promote_after=0,
+                cooldown_windows=device_retry_windows,
+                start_state=STATE_HEALTHY)
+        # The abandoned in-flight device call (a wedged call may still be
+        # executing inside the aggregator — nothing touches it until the
+        # event fires) and its result box, inspected once on completion.
         self._device_inflight = None
-        self._device_wedged_at: int | None = None
+        self._device_abandoned: dict | None = None
         self._windows_seen = 0
         self._symbolizer = symbolizer
         self._labels = labels_manager
@@ -201,10 +226,11 @@ class CPUProfiler:
         leaves, observed as multi-minute backend-init hangs on real
         hardware). With a fallback configured, device aggregation runs on
         a watchdog thread bounded by device_timeout_s; on timeout the
-        window is aggregated on the CPU and the device is retried only
-        after device_retry_windows windows AND once the abandoned call has
-        actually returned (the aggregator's state is not touched while a
-        wedged call may still be executing inside it)."""
+        window is aggregated on the CPU and the device-health registry
+        demotes the backend — re-trusted only after its cooldown, its
+        probe gate, AND one shadow window whose device result matches
+        the CPU fallback (and never while the abandoned call may still
+        be executing inside the aggregator)."""
         t0 = time.perf_counter()
         self._windows_seen += 1
         # Device failures are handled (and logged as such) inside
@@ -220,55 +246,134 @@ class CPUProfiler:
         return self._guarded(lambda: self._aggregator.aggregate(snapshot),
                              lambda: self._fallback.aggregate(snapshot))
 
-    def _guarded(self, thunk, fallback_thunk):
-        """Run thunk on the device backend under the hang watchdog;
-        fallback_thunk on failure/hang (see _aggregate_guarded docs)."""
-        if self._fallback is None:
-            return thunk()
+    @property
+    def _device_wedged_at(self):
+        """Window index of the hang the device is currently demoted for
+        (None while trusted) — kept for tests and the status page; the
+        registry is the single owner of the state."""
+        return self._health.wedged_at if self._health is not None else None
 
-        if self._device_wedged_at is not None:
-            # Device previously hung. Only retry after the cooldown and
-            # once the abandoned call has finished with the aggregator.
-            cooled = (self._windows_seen - self._device_wedged_at
-                      >= self._device_retry_windows)
-            if not (cooled and self._device_inflight.is_set()):
-                return fallback_thunk()
-            self._device_wedged_at = None
-            self._device_inflight = None
-            _log.info("retrying device aggregation after cooldown")
-
-        # A daemon thread, NOT a ThreadPoolExecutor: pool workers are
-        # non-daemon and joined at interpreter exit, so one wedged call
-        # would block agent shutdown forever. A daemon thread is truly
-        # abandonable.
-        box: dict = {}
-        done = threading.Event()
-
-        def call():
-            try:
-                box["out"] = thunk()
-            except BaseException as e:  # noqa: BLE001 - surfaced below
-                box["err"] = e
-            finally:
-                done.set()
-
-        threading.Thread(target=call, name="aggregate-device",
-                         daemon=True).start()
-        if done.wait(self._device_timeout):
-            if "err" not in box:
-                return box["out"]
-            _log.warn("device aggregation failed; using CPU fallback",
+    def _inspect_abandoned(self) -> None:
+        """An abandoned device call that finally RETURNED: its outcome
+        used to be silently discarded (an error set into box["err"] after
+        the timeout went nowhere). Inspect it exactly once — log the
+        late failure, count ok/err — and release the inflight gate."""
+        done = self._device_inflight
+        if done is None or not done.is_set():
+            return
+        box = self._device_abandoned or {}
+        if "err" in box:
+            self.metrics.device_abandoned_err_total += 1
+            _log.warn("abandoned device call completed with an error",
                       aggregator=type(self._aggregator).__name__,
                       error=repr(box["err"]))
         else:
-            self._device_wedged_at = self._windows_seen
+            self.metrics.device_abandoned_ok_total += 1
+            _log.info("abandoned device call completed",
+                      aggregator=type(self._aggregator).__name__)
+        self._device_inflight = None
+        self._device_abandoned = None
+
+    def _device_call_clear(self) -> bool:
+        return self._device_inflight is None \
+            or self._device_inflight.is_set()
+
+    def _watchdog_call(self, thunk):
+        """Run thunk under the abandonable bounded-call guard
+        (utils/bounded.py) with the device timeout. Returns
+        ("ok", out) | ("err", exc) | ("hang", None); a hang leaves the
+        call registered as in-flight (the aggregator's state is not
+        touched while it may still be executing inside it)."""
+        from parca_agent_tpu.utils.bounded import bounded_call
+
+        def site():
+            faults.inject("device.dispatch")
+            return thunk()
+
+        status, out, done, box = bounded_call(
+            site, self._device_timeout, thread_name="aggregate-device")
+        if status == "hang":
             self._device_inflight = done
+            self._device_abandoned = box
+        return status, out
+
+    @staticmethod
+    def _shadow_match(dev_out, cpu_out) -> bool:
+        """Promotion-gate A/B: does the device result agree with the CPU
+        fallback's? Profile lists compare per-pid (mass, unique-stack
+        count) digests; the fast path's raw counts compare total window
+        mass (the same invariant bench.py's A/B phases assert)."""
+        def norm(o):
+            if isinstance(o, tuple) and len(o) == 2 \
+                    and isinstance(o[0], str):
+                kind, payload = o
+                if kind == "counts":
+                    import numpy as np
+
+                    return int(np.asarray(payload).astype(np.int64).sum())
+                return payload
+            return o
+
+        a, b = norm(dev_out), norm(cpu_out)
+        if isinstance(a, int) or isinstance(b, int):
+            def mass(x):
+                return x if isinstance(x, int) \
+                    else sum(int(p.total()) for p in x)
+
+            return mass(a) == mass(b)
+        from parca_agent_tpu.aggregator.tpu import shadow_compare
+
+        return shadow_compare(a, b)
+
+    def _guarded(self, thunk, fallback_thunk):
+        """Run thunk on the device backend under the hang watchdog and
+        the health registry's demote/promote supervision; fallback_thunk
+        while degraded or on failure/hang (see _aggregate_guarded docs).
+        Promotion back to the device passes through one SHADOW window:
+        both backends aggregate, the results must match, and the window
+        ships the CPU result either way."""
+        if self._fallback is None:
+            return thunk()
+        self._inspect_abandoned()
+        mode = self._health.window_mode()
+        if mode != "fallback" and not self._device_call_clear():
+            # The abandoned call still owns the aggregator's state: no
+            # device touch (not even a shadow) until it returns.
+            mode = "fallback"
+        if mode == "fallback":
+            self._health.record_fallback_window()
+            return fallback_thunk()
+
+        status, out = self._watchdog_call(thunk)
+
+        if mode == "shadow":
+            cpu_out = fallback_thunk()
+            if status == "hang":
+                _log.error("device hung during its shadow window; "
+                           "re-demoting", timeout_s=self._device_timeout)
+                self._health.record_hang()
+            else:
+                matched = status == "ok" \
+                    and self._shadow_match(out, cpu_out)
+                err = repr(out)[:200] if status == "err" else ""
+                self._health.record_shadow(matched, error=err)
+            return cpu_out
+
+        if status == "ok":
+            self._health.record_dispatch_ok()
+            return out
+        if status == "err":
+            _log.warn("device aggregation failed; using CPU fallback",
+                      aggregator=type(self._aggregator).__name__,
+                      error=repr(out))
+            self._health.record_dispatch_error(out)
+        else:
             _log.error(
                 "device aggregation hung; abandoning call and using the "
                 "CPU fallback",
                 aggregator=type(self._aggregator).__name__,
-                timeout_s=self._device_timeout,
-                retry_after_windows=self._device_retry_windows)
+                timeout_s=self._device_timeout)
+            self._health.record_hang()
         return fallback_thunk()
 
     def run_iteration(self) -> bool:
@@ -340,6 +445,10 @@ class CPUProfiler:
             # Quarantine time is window time: cooldown/probation advance
             # once per iteration, whether or not the window shipped.
             self._quarantine.tick_window()
+        if self._health is not None:
+            # Same clock for the device-backend state machine: demote
+            # cooldowns and re-probe scheduling advance per window.
+            self._health.tick_window()
         self.metrics.last_attempt_duration_s = time.perf_counter() - t_start
         self._manage_gc(self.metrics.attempts_total)
         if self._on_iteration is not None:
@@ -587,34 +696,26 @@ class CPUProfiler:
                     snapshot.period_ns)
             import numpy as np
 
+            from parca_agent_tpu.utils.bounded import bounded_call
+
             # The aggregator's counts buffer is only valid for one close;
             # an abandoned encode may still be reading after that.
             counts_copy = np.asarray(counts).copy()
-            box: dict = {}
-            done = threading.Event()
-
-            def call():
-                try:
-                    box["out"] = self._encoder.encode(
-                        counts_copy, snapshot.time_ns, snapshot.window_ns,
-                        snapshot.period_ns)
-                except BaseException as e:  # noqa: BLE001 - surfaced below
-                    box["err"] = e
-                finally:
-                    done.set()
-
-            threading.Thread(target=call, name="encode-deadline",
-                             daemon=True).start()
-            if not done.wait(self._encode_deadline):
+            status, out, done, box = bounded_call(
+                lambda: self._encoder.encode(
+                    counts_copy, snapshot.time_ns, snapshot.window_ns,
+                    snapshot.period_ns),
+                self._encode_deadline, thread_name="encode-deadline")
+            if status == "hang":
                 self._encode_inflight = done
                 self._encode_abandoned = box
                 self.metrics.encode_deadline_hits_total += 1
                 raise RuntimeError(
                     f"encode exceeded the soft deadline "
                     f"({self._encode_deadline}s); scalar fallback")
-            if "err" in box:
-                raise box["err"]
-            return box["out"]
+            if status == "err":
+                raise out
+            return out
         finally:
             self.metrics.last_encode_duration_s = \
                 time.perf_counter() - t0

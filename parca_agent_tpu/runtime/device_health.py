@@ -1,0 +1,392 @@
+"""Device-runtime health: bounded bring-up and demote/promote supervision.
+
+``runtime/quarantine.py`` owns per-pid trust; this module owns the
+ACCELERATOR BACKEND's lifecycle. The failure mode it exists for is the
+one the bench trajectory recorded twice (BENCH_r05: "device probe:
+attempt hung >420s"): a wedged device runtime blocks *inside a C call*
+— backend init, a dispatch, a fetch — that no exception ever leaves and
+no thread can cancel. An always-on profiler must therefore (a) never
+touch the backend from its capture loop without an abandonable guard,
+and (b) never pay an unbounded backend *init*: bring-up probes run in a
+THROWAWAY SUBPROCESS with a hard deadline and a kill, so a wedged init
+costs one dead child, not a hung agent.
+
+State machine (all transitions on the profiler's window clock — a
+stalled agent must not silently serve out cooldowns):
+
+    probing ──probe ok──────────────► healthy
+       │ probe fail/hang                 │ dispatch hang, or
+       ▼                                 │ failure_strikes consecutive
+    degraded (CPU fallback) ◄────────────┘ dispatch errors
+       │ cooldown windows (doubles per trip, capped), then
+       │ k consecutive healthy probes (--device-promote-after), then
+       │ ONE shadow window: device + CPU fallback both aggregate and
+       │ the results must MATCH (the aggregator A/B gate — a device
+       │ that answers promptly but wrongly stays demoted)
+       ├──shadow match──────────────► healthy   (promotion)
+       ├──shadow mismatch/hang──────► degraded  (doubled cooldown)
+       └──trips > dead_after_trips──► dead      (fallback forever;
+                                                 0 = keep re-probing)
+
+While degraded every window ships via the CPU fallback: windows are
+COUNTED (``fallback_windows_total``), never dropped. The profiler's
+per-window hang watchdog (`profiler/cpu.py:_guarded`) reports into this
+registry, so wedge accounting, cooldowns, and metrics live in one place;
+`/metrics` and `/healthz` render :meth:`snapshot`.
+
+Chaos sites: ``device.probe`` fires inside the probe thread,
+``device.dispatch`` inside the profiler's guarded device call — both
+accept the duration-bearing ``hang`` kind (utils/faults.py).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+
+from parca_agent_tpu.utils import faults
+from parca_agent_tpu.utils.log import get_logger
+
+_log = get_logger("device-health")
+
+STATE_PROBING = "probing"
+STATE_HEALTHY = "healthy"
+STATE_DEGRADED = "degraded"
+STATE_DEAD = "dead"
+
+STATES = (STATE_PROBING, STATE_HEALTHY, STATE_DEGRADED, STATE_DEAD)
+
+# One tiny device round trip: backend init + put + jit + fetch — the same
+# aha-moment op bench.py's liveness probe runs. Printing "1" proves the
+# whole path, not just that the import survived.
+_PROBE_CODE = (
+    "import numpy as np, jax\n"
+    "x = jax.device_put(np.zeros(8, np.int32))\n"
+    "print(int(np.asarray(jax.jit(lambda a: a + 1)(x))[0]))\n"
+)
+
+
+def subprocess_probe(timeout_s: float, code: str = _PROBE_CODE
+                     ) -> tuple[bool, str]:
+    """One backend bring-up probe in a throwaway subprocess, killed at
+    ``timeout_s``. A wedged backend init cannot be cancelled from a
+    thread (it hangs inside a C call), but a child process CAN be
+    killed — this is the only hang-proof shape for the probe. Returns
+    (ok, detail)."""
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{timeout_s:.0f}s (child killed)"
+    except OSError as e:  # pragma: no cover - spawn failure is exotic
+        return False, f"probe spawn failed: {e!r}"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        last = tail[-1][-200:] if tail else "no output"
+        return False, f"probe rc={r.returncode}: {last}"
+    if (r.stdout or "").strip().splitlines()[-1:] != ["1"]:
+        return False, f"probe wrong output: {(r.stdout or '')[:80]!r}"
+    return True, "ok"
+
+
+class DeviceHealthRegistry:
+    """The device-backend trust state machine (module docs above).
+
+    ``probe`` is a zero-arg callable returning ``(ok, detail)`` — the
+    CLI passes :func:`subprocess_probe`; ``None`` disables the probe
+    phase entirely (cooldown expiry goes straight to the shadow window,
+    the pre-registry retry semantics the profiler's embedded default
+    keeps). Probes run on a daemon thread so the window loop never waits
+    on one; a probe that outlives ``probe_deadline_s`` is counted as a
+    hang and its eventual (stale) result ignored.
+
+    All mutation is lock-protected: the profiler thread reports faults
+    and ticks windows, probe threads deliver results, the HTTP thread
+    reads snapshots.
+    """
+
+    def __init__(self, probe=None, probe_timeout_s: float = 60.0,
+                 probe_deadline_s: float | None = None,
+                 promote_after: int = 2,
+                 cooldown_windows: int = 3,
+                 max_cooldown_windows: int = 240,
+                 failure_strikes: int = 3,
+                 dead_after_trips: int = 0,
+                 start_state: str = STATE_PROBING,
+                 clock=time.monotonic):
+        self._probe = probe
+        self._probe_timeout = probe_timeout_s
+        # Grace over the probe's own (subprocess) timeout: the in-process
+        # deadline only exists for probes wedged BEFORE their own bound
+        # can fire (a hung spawn, an injected hang at the site).
+        self._probe_deadline = (probe_deadline_s
+                                if probe_deadline_s is not None
+                                else probe_timeout_s + 5.0)
+        self._promote_after = max(0, promote_after)
+        self._base_cooldown = max(1, cooldown_windows)
+        self._max_cooldown = max(self._base_cooldown, max_cooldown_windows)
+        self._failure_strikes = max(1, failure_strikes)
+        self._dead_after = max(0, dead_after_trips)
+        self._clock = clock
+        self._lock = threading.Lock()
+
+        if start_state not in STATES:
+            raise ValueError(f"unknown start state {start_state!r}")
+        self.state = start_state
+        self.windows = 0              # the window clock (tick_window)
+        self.trips = 0                # demotions + failed recoveries
+        self.cooldown_left = 0
+        self.consecutive_ok_probes = 0
+        self.shadow_pending = False
+        self.wedged_at: int | None = None   # window of the last hang
+        self.last_demote_window: int | None = None
+        self.last_promote_window: int | None = None
+        self.last_error: str = ""
+        self._consec_failures = 0
+        self._probe_gen = 0
+        self._probe_started_at: float | None = None
+        self.stats = {
+            "probes_total": 0,
+            "probes_ok": 0,
+            "probes_failed": 0,   # == probes_total - probes_ok (invariant)
+            "probes_hung": 0,     # the probes_failed that were deadline
+            #                       overruns (BENCH_r05's failure mode)
+            "hangs_total": 0,
+            "dispatch_errors_total": 0,
+            "demotions_total": 0,
+            "promotions_total": 0,
+            "shadow_windows_total": 0,
+            "shadow_mismatches_total": 0,
+            "fallback_windows_total": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Kick off the bounded bring-up. With no probe configured the
+        registry trusts the backend optimistically (the first guarded
+        dispatch is itself watchdogged); with one, the agent captures on
+        the CPU fallback until the probe child proves the backend out —
+        a wedged init costs a killed child, never a hung agent."""
+        with self._lock:
+            if self.state != STATE_PROBING:
+                return
+            if self._probe is None:
+                self.state = STATE_HEALTHY
+                return
+            self._launch_probe_locked()
+
+    # -- profiler-facing decisions -------------------------------------------
+
+    def window_mode(self) -> str:
+        """What this window's aggregation should do: ``device`` (normal),
+        ``shadow`` (run device AND fallback, compare, report via
+        :meth:`record_shadow`), or ``fallback``. The caller additionally
+        gates device/shadow on its own abandoned-call state — an
+        abandoned dispatch may still be executing inside the
+        aggregator."""
+        with self._lock:
+            if self.state == STATE_HEALTHY:
+                return "device"
+            if self.state == STATE_DEGRADED and self.shadow_pending:
+                return "shadow"
+            return "fallback"
+
+    def record_dispatch_ok(self) -> None:
+        with self._lock:
+            self._consec_failures = 0
+
+    def record_dispatch_error(self, exc: BaseException) -> None:
+        """A device call that FAILED (raised) — one strike; repeated
+        consecutive failures demote (a flapping backend is as useless as
+        a wedged one, just cheaper to discover)."""
+        with self._lock:
+            self.stats["dispatch_errors_total"] += 1
+            self.last_error = repr(exc)[:200]
+            self._consec_failures += 1
+            if self.state == STATE_HEALTHY \
+                    and self._consec_failures >= self._failure_strikes:
+                self._demote_locked("dispatch failures")
+
+    def record_hang(self) -> None:
+        """The guarded device call blew its watchdog and was abandoned.
+        Demotes immediately — a hang is never a strike to accumulate
+        (the next one would stall another window's deadline)."""
+        with self._lock:
+            self.stats["hangs_total"] += 1
+            self.wedged_at = self.windows
+            self.last_error = "device call hung (abandoned)"
+            self.shadow_pending = False  # a shadow that hung failed too
+            self._demote_locked("dispatch hang")
+
+    def record_fallback_window(self) -> None:
+        with self._lock:
+            self.stats["fallback_windows_total"] += 1
+
+    def record_shadow(self, matched: bool, error: str = "") -> None:
+        """Outcome of the promotion gate's A/B window."""
+        with self._lock:
+            self.stats["shadow_windows_total"] += 1
+            self.shadow_pending = False
+            if matched:
+                trips_survived = self.trips
+                self.state = STATE_HEALTHY
+                self.trips = 0
+                self.cooldown_left = 0
+                self.consecutive_ok_probes = 0
+                self._consec_failures = 0
+                self.wedged_at = None
+                self.last_promote_window = self.windows
+                self.stats["promotions_total"] += 1
+                _log.info("device promoted: shadow window matched the "
+                          "CPU fallback", window=self.windows,
+                          trips_survived=trips_survived)
+                return
+            self.stats["shadow_mismatches_total"] += 1
+            self.last_error = error or "shadow window mismatched the CPU " \
+                                       "fallback"
+            _log.warn("device promotion refused: shadow window did not "
+                      "match the CPU fallback; re-demoting",
+                      error=self.last_error)
+            self._demote_locked("shadow mismatch")
+
+    # -- the window clock ----------------------------------------------------
+
+    def tick_window(self) -> None:
+        """Advance cooldowns and drive re-probes; the profiler calls this
+        once per iteration (window time, like the quarantine registry)."""
+        probe_needed = False
+        with self._lock:
+            self.windows += 1
+            self._check_probe_deadline_locked()
+            if self.state != STATE_DEGRADED or self.shadow_pending:
+                return
+            if self.cooldown_left > 0:
+                self.cooldown_left -= 1
+                if self.cooldown_left > 0:
+                    return
+            if self._probe is None \
+                    or self.consecutive_ok_probes >= self._promote_after:
+                # Promotion gate's last hurdle: the next window runs the
+                # device in the fallback's shadow.
+                self.shadow_pending = True
+                return
+            if self._probe_started_at is None:
+                probe_needed = True
+                self._launch_probe_locked()
+        if probe_needed:
+            _log.debug("device re-probe launched", window=self.windows)
+
+    # -- probes --------------------------------------------------------------
+
+    def _launch_probe_locked(self) -> None:
+        self._probe_gen += 1
+        self._probe_started_at = self._clock()
+        self.stats["probes_total"] += 1
+        threading.Thread(target=self._run_probe, args=(self._probe_gen,),
+                         name="device-probe", daemon=True).start()
+
+    def _run_probe(self, gen: int) -> None:
+        try:
+            faults.inject("device.probe")
+            ok, detail = self._probe()
+        except BaseException as e:  # noqa: BLE001 - a broken probe = failed
+            ok, detail = False, repr(e)[:200]
+        self._on_probe_result(gen, bool(ok), str(detail))
+
+    def _check_probe_deadline_locked(self) -> None:
+        """A probe that outlived its deadline is a HANG: count it failed
+        now and ignore its eventual result (generation bump). The probe
+        subprocess bounds itself; this catches wedged spawns and
+        injected in-process hangs."""
+        if self._probe_started_at is None:
+            return
+        if self._clock() - self._probe_started_at <= self._probe_deadline:
+            return
+        self._probe_gen += 1  # stale result will be dropped
+        self._probe_started_at = None
+        self.stats["probes_failed"] += 1
+        self.stats["probes_hung"] += 1
+        self._note_probe_failed_locked(
+            f"probe overran its deadline ({self._probe_deadline:.0f}s)")
+
+    def _on_probe_result(self, gen: int, ok: bool, detail: str) -> None:
+        with self._lock:
+            if gen != self._probe_gen or self.state == STATE_DEAD:
+                return  # stale (deadline already charged it) or moot
+            self._probe_started_at = None
+            if ok:
+                self.stats["probes_ok"] += 1
+                self.consecutive_ok_probes += 1
+                if self.state == STATE_PROBING:
+                    # Bring-up: the backend proved out; no shadow needed,
+                    # there is nothing demoted to distrust yet.
+                    self.state = STATE_HEALTHY
+                    _log.info("device backend probe ok; starting on the "
+                              "device", detail=detail)
+                elif self.state == STATE_DEGRADED \
+                        and self.consecutive_ok_probes < self._promote_after:
+                    # More consecutive probes wanted: next window's tick
+                    # launches the next one.
+                    self.cooldown_left = 0
+                return
+            self.stats["probes_failed"] += 1
+            self._note_probe_failed_locked(detail)
+
+    def _note_probe_failed_locked(self, detail: str) -> None:
+        self.consecutive_ok_probes = 0
+        self.last_error = detail[:200]
+        _log.warn("device probe failed", error=self.last_error,
+                  trips=self.trips)
+        self._demote_locked("probe failure")
+
+    # -- transitions ---------------------------------------------------------
+
+    def _demote_locked(self, reason: str) -> None:
+        """One more trip: enter (or stay in) degraded with a doubled,
+        capped cooldown; past the trip budget, dead."""
+        self.trips += 1
+        self.consecutive_ok_probes = 0
+        self.shadow_pending = False
+        self.cooldown_left = min(
+            self._base_cooldown * (2 ** (self.trips - 1)),
+            self._max_cooldown)
+        if self.state != STATE_DEGRADED:
+            self.last_demote_window = self.windows
+            self.stats["demotions_total"] += 1
+        if self._dead_after and self.trips > self._dead_after:
+            self.state = STATE_DEAD
+            _log.error("device re-probe budget exhausted; backend marked "
+                       "dead (CPU fallback is permanent)",
+                       trips=self.trips, reason=reason,
+                       error=self.last_error)
+            return
+        prev = self.state
+        self.state = STATE_DEGRADED
+        if prev != STATE_DEGRADED:
+            _log.warn("device demoted to the CPU fallback", reason=reason,
+                      window=self.windows, cooldown_windows=self.cooldown_left,
+                      trips=self.trips)
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-shaped view for /healthz and the bench artifact."""
+        with self._lock:
+            return {
+                "state": self.state,
+                "windows": self.windows,
+                "trips": self.trips,
+                "cooldown_windows_left": self.cooldown_left,
+                "consecutive_ok_probes": self.consecutive_ok_probes,
+                "shadow_pending": self.shadow_pending,
+                "probe_in_flight": self._probe_started_at is not None,
+                "wedged_at_window": self.wedged_at,
+                "last_demote_window": self.last_demote_window,
+                "last_promote_window": self.last_promote_window,
+                "last_error": self.last_error,
+                "stats": dict(self.stats),
+            }

@@ -22,6 +22,17 @@ injected fault rides the same per-pid attribution path as real poison):
     symbolize.kernel  the batched kallsyms resolve
     unwind.build      building one mapping's unwind table
 
+and, on the device-runtime side (docs/robustness.md "device & fleet
+health" — the ``hang`` kind is duration-bearing: the site sleeps ``ms``
+milliseconds, default one hour, modeling a wedged C call that no
+exception ever leaves; the caller's watchdog/deadline machinery is what
+must bound it):
+
+    device.probe      one backend bring-up probe (runtime/device_health.py)
+    device.dispatch   the guarded device aggregation call (profiler/cpu.py)
+    fleet.join        jax.distributed fleet join (parallel/distributed.py)
+    fleet.collective  one fleet merge/re-probe collective round
+
 Sites call :func:`inject` which is a no-op until an injector is installed
 (via the CLI's --fault-inject flag, the PARCA_FAULTS env var, or a test):
 production pays one module-attribute read per site.
@@ -36,12 +47,14 @@ Rule spec grammar (CLI/env), semicolon-separated::
     site:kind[:k=v[,k=v...]]
 
     kinds:  unavailable | handshake | error | latency | disk_full | crash
-            | poison
+            | poison | hang
     keys:   p=<prob 0..1>   firing probability (default 1)
             after=<s>       rule arms this many seconds after install
             for=<s>         rule disarms this many seconds after arming
             count=<n>       max total firings
-            ms=<millis>     latency kinds: injected delay
+            ms=<millis>     latency/hang kinds: injected delay (hang
+                            defaults to 3600000 — "forever" at any
+                            realistic watchdog deadline)
 
 Example — a scripted 60 s store outage five seconds in, plus a flaky
 spool disk::
@@ -134,7 +147,10 @@ class FaultRule:
 
 
 _KINDS = ("unavailable", "handshake", "error", "latency", "disk_full",
-          "crash", "poison")
+          "crash", "poison", "hang")
+
+# A hang with no explicit ms= is "forever" relative to any watchdog.
+_HANG_DEFAULT_S = 3600.0
 
 
 def parse_rules(spec: str) -> list[FaultRule]:
@@ -163,6 +179,8 @@ def parse_rules(spec: str) -> list[FaultRule]:
                 rule.latency_s = float(v) / 1e3
             else:
                 raise ValueError(f"unknown fault rule key {k!r} in {part!r}")
+        if rule.kind == "hang" and rule.latency_s == 0.0:
+            rule.latency_s = _HANG_DEFAULT_S
         rules.append(rule)
     return rules
 
@@ -193,9 +211,9 @@ class FaultInjector:
         return True
 
     def check(self, site: str) -> None:
-        """Apply every matching armed rule: latency rules sleep, error
-        rules raise (first match wins for raises). Thread-safe; draws are
-        serialized so a fixed seed stays reproducible."""
+        """Apply every matching armed rule: latency/hang rules sleep,
+        error rules raise (first match wins for raises). Thread-safe;
+        draws are serialized so a fixed seed stays reproducible."""
         delay = 0.0
         raise_rule: FaultRule | None = None
         with self._lock:
@@ -207,7 +225,7 @@ class FaultInjector:
                     continue
                 rule.fired += 1
                 self.fired[site] = self.fired.get(site, 0) + 1
-                if rule.kind == "latency":
+                if rule.kind in ("latency", "hang"):
                     delay += rule.latency_s
                 elif raise_rule is None:
                     raise_rule = rule

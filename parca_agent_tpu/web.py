@@ -57,7 +57,8 @@ def render_status_page(profilers, version: str = "dev",
 
 
 def render_metrics(profilers, batch_client=None, extra: dict | None = None,
-                   supervisor=None, quarantine=None) -> str:
+                   supervisor=None, quarantine=None,
+                   device_health=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics."""
     lines = []
@@ -85,6 +86,10 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
              p.metrics.encode_backpressure_total, lab)
         emit("parca_agent_profiler_encode_deadline_hits_total",
              p.metrics.encode_deadline_hits_total, lab)
+        emit("parca_agent_profiler_device_abandoned_ok_total",
+             p.metrics.device_abandoned_ok_total, lab)
+        emit("parca_agent_profiler_device_abandoned_err_total",
+             p.metrics.device_abandoned_err_total, lab)
         pipe = getattr(p, "_pipeline", None)
         if pipe is not None:
             # Encode-pipeline observability: how much encode/ship work ran
@@ -157,6 +162,24 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                  counts[f"level_{level}"], f'{{level="{level}"}}')
         for k, v in quarantine.stats.items():
             emit(f"parca_agent_quarantine_{k}", v)
+    if device_health is not None:
+        # Device-runtime health (docs/robustness.md "device & fleet
+        # health"): one-hot state gauge (exactly one state is 1), the
+        # window-clock positions of the last demotion/promotion, and the
+        # probe/hang/shadow counters.
+        snap = device_health.snapshot()
+        from parca_agent_tpu.runtime.device_health import STATES
+
+        for state in STATES:
+            emit("parca_agent_device_state",
+                 int(snap["state"] == state), f'{{state="{state}"}}')
+        emit("parca_agent_device_cooldown_windows",
+             snap["cooldown_windows_left"])
+        emit("parca_agent_device_shadow_pending",
+             int(snap["shadow_pending"]))
+        emit("parca_agent_device_trips", snap["trips"])
+        for k, v in snap["stats"].items():
+            emit(f"parca_agent_device_{k}", v)
     for k, v in (extra or {}).items():
         emit(k, v)
     return "\n".join(lines) + "\n"
@@ -166,7 +189,8 @@ class AgentHTTPServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 7071,
                  profilers=(), batch_client=None, listener=None,
                  version: str = "dev", extra_metrics=None,
-                 capture_info=None, supervisor=None, quarantine=None):
+                 capture_info=None, supervisor=None, quarantine=None,
+                 device_health=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -192,7 +216,8 @@ class AgentHTTPServer:
                     self._send(200, render_metrics(
                         outer.profilers, outer.batch_client, extra,
                         supervisor=outer.supervisor,
-                        quarantine=outer.quarantine).encode())
+                        quarantine=outer.quarantine,
+                        device_health=outer.device_health).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
@@ -252,10 +277,14 @@ class AgentHTTPServer:
                 supervisor wired, reports plain liveness like /healthy."""
                 quarantine = (outer.quarantine.snapshot()
                               if outer.quarantine is not None else None)
+                device = (outer.device_health.snapshot()
+                          if outer.device_health is not None else None)
                 if outer.supervisor is None:
                     body = {"status": "healthy", "actors": {}}
                     if quarantine is not None:
                         body["quarantine"] = quarantine
+                    if device is not None:
+                        body["device"] = device
                     self._send(200, json.dumps(body).encode(),
                                "application/json")
                     return
@@ -269,6 +298,12 @@ class AgentHTTPServer:
                     # is doing its job — containing them — but operators
                     # need to see WHO is degraded and why.
                     body["quarantine"] = quarantine
+                if device is not None:
+                    # Likewise a demoted device: the agent is still
+                    # shipping every window (CPU fallback) — degraded
+                    # backend != unhealthy agent; the state is surfaced
+                    # for operators, not for the readiness verdict.
+                    body["device"] = device
                 self._send(503 if status == "dead" else 200,
                            json.dumps(body, indent=1).encode(),
                            "application/json")
@@ -315,6 +350,7 @@ class AgentHTTPServer:
         self.listener = listener
         self.supervisor = supervisor
         self.quarantine = quarantine
+        self.device_health = device_health
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info
