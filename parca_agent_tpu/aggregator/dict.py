@@ -183,6 +183,30 @@ def _close_program(id_cap: int, n_fetch: int, width: int,
     return jax.jit(make_close(id_cap, n_fetch, width, n_over_buf))
 
 
+def registry_content_digest(mappings, loc_address, loc_normalized,
+                            loc_mapping_id, loc_is_kernel) -> bytes:
+    """16-byte digest of one pid registry's full content — mappings (all
+    fields, including the normalization base) and every location row.
+    This is the content-addressing identity the statics snapshot uses
+    (pprof/statics_store.py): a record whose stored digest does not match
+    the digest recomputed from its decoded content is discarded as
+    corrupt, and the pprof statics cached against this content are valid
+    exactly as long as the content is byte-identical."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for m in mappings:
+        h.update(("%d,%d,%d,%d,%d,%s\0%s\0" % (
+            m.id, m.start, m.end, m.offset, m.base, m.path,
+            m.build_id)).encode())
+    h.update(b";")
+    h.update(np.asarray(loc_address, np.uint64).tobytes())
+    h.update(np.asarray(loc_normalized, np.uint64).tobytes())
+    h.update(np.asarray(loc_mapping_id, np.int32).tobytes())
+    h.update(np.asarray(loc_is_kernel, bool).tobytes())
+    return h.digest()
+
+
 @dataclasses.dataclass
 class _PidRegistry:
     """Per-pid incremental location registry (grows, never shrinks).
@@ -266,6 +290,11 @@ class DictAggregator:
         self._loc_off = np.zeros(1025, np.int64)
         self._loc_flat = np.empty(4096, np.int32)
         self._pids: dict[int, _PidRegistry] = {}
+        # Bumped whenever any per-pid registry may have changed (insert
+        # batches, adoption, rotation). Statics consumers use it to skip
+        # the O(pids) staleness scan when nothing could be dirty — the
+        # scan used to run on EVERY drain-tick prebuild.
+        self._reg_version = 0
         # Device twin (created lazily; None until first window).
         self._dev = None
         # Streaming-window state (feed/close_window protocol).
@@ -329,6 +358,65 @@ class DictAggregator:
         self._needs_reset = True
         self.feed(snapshot, hashes)
         return self.close_window(copy=True)
+
+    # -- registry identity (statics snapshot support) ------------------------
+
+    @property
+    def registry_epoch(self) -> int:
+        """Rotation epoch of the id space: bumped whenever a cold-stack
+        rotation remaps stack ids wholesale. Mirrors consumers (the
+        window encoder, the statics snapshot header) key their validity
+        on this."""
+        return self.stats.get("rotations", 0)
+
+    def registry_digest(self, pid: int, n_mappings: int | None = None,
+                        n_locs: int | None = None) -> bytes | None:
+        """Content digest of one pid's location registry (bounded reads
+        for encoder-thread callers, like _reg_cap); None for an unknown
+        pid. This is the PUBLIC identity exposure (tests pin that an
+        adopted registry digests equal to a replay-built one); internal
+        writers digest their loop-local registry object directly via
+        registry_content_digest to stay race-free against rotation."""
+        reg = self._pids.get(pid)
+        if reg is None:
+            return None
+        nm = len(reg.mappings) if n_mappings is None else n_mappings
+        nl = min(len(reg.loc_address), len(reg.loc_normalized),
+                 len(reg.loc_mapping_id), len(reg.loc_is_kernel))
+        if n_locs is not None:
+            nl = min(nl, n_locs)
+        return registry_content_digest(
+            reg.mappings[:nm], reg.loc_address[:nl],
+            reg.loc_normalized[:nl], reg.loc_mapping_id[:nl],
+            reg.loc_is_kernel[:nl])
+
+    def adopt_registry(self, pid: int, mappings, loc_address,
+                       loc_normalized, loc_mapping_id,
+                       loc_is_kernel) -> bool:
+        """Install a snapshot-restored per-pid location registry (the
+        statics store's warm-restart path). Cold-start only: refused
+        (False) once the pid has a registry — adoption must never alias
+        or reorder live loc ids. Adopted content is a valid append-only
+        prefix: the pid's first live window translates re-seen addresses
+        to their restored ids and appends only the genuinely new ones,
+        which is exactly what keeps the restored statics blobs valid."""
+        if pid in self._pids:
+            return False
+        # One C-level pass to plain ints (dict keys must be exact ints;
+        # a np.uint64 key would silently miss every later lookup).
+        addrs = np.asarray(loc_address, np.uint64).tolist()
+        self._pids[pid] = _PidRegistry(
+            addr_to_loc=dict(zip(addrs, range(1, len(addrs) + 1))),
+            loc_address=addrs,
+            loc_normalized=np.asarray(loc_normalized, np.uint64).tolist(),
+            loc_mapping_id=np.asarray(loc_mapping_id, np.int32).tolist(),
+            loc_is_kernel=np.asarray(loc_is_kernel, bool).tolist(),
+            mappings=list(mappings),
+            mapping_index={(m.start, m.end, m.offset): m.id
+                           for m in mappings},
+        )
+        self._reg_version += 1
+        return True
 
     # -- streaming window protocol -------------------------------------------
     #
@@ -645,6 +733,7 @@ class DictAggregator:
         self._acc = None
         self._prev_counts = None
         self._prev_n_over = 0  # sideband prediction resets with it
+        self._reg_version += 1
         self.stats["rotations"] = self.stats.get("rotations", 0) + 1
 
     # -- internals ----------------------------------------------------------
@@ -960,6 +1049,7 @@ class DictAggregator:
                           out=flat_vals, out_starts=boff[sel])
 
         self._append_id_meta(pids.astype(np.int32), depths64, flat_vals)
+        self._reg_version += 1
 
     def _build_profiles(self, snapshot: WindowSnapshot,
                         counts: np.ndarray) -> list[PidProfile]:

@@ -154,6 +154,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "and the window ships via the scalar fallback; "
                         "0 disables. Applies when the encode pipeline is "
                         "off or has self-disabled")
+    p.add_argument("--statics-snapshot-path", default="",
+                   help="file for the warm pprof-statics + registry "
+                        "snapshot (requires --fast-encode): the encode "
+                        "worker rewrites it every "
+                        "--statics-snapshot-interval windows "
+                        "(CRC-framed, tmp+rename crash-safe) and a "
+                        "restart adopts it — statics warm-build instead "
+                        "of the multi-second cold rebuild; stale/corrupt "
+                        "records are individually discarded. Empty "
+                        "disables")
+    p.add_argument("--statics-snapshot-interval", type=int, default=6,
+                   help="windows between statics snapshots (the restart "
+                        "warmth/IO trade; each write is one atomic file "
+                        "replace on the encode worker)")
+    p.add_argument("--statics-snapshot-max-age", type=float, default=900.0,
+                   help="snapshots older than this many seconds are "
+                        "STALE at adoption (the processes they describe "
+                        "are likely gone); 0 = no age bar")
+    p.add_argument("--statics-cache-bytes", type=int, default=256 << 20,
+                   help="byte cap of the encoder's content-addressed "
+                        "statics cache (digest of build inputs -> built "
+                        "bytes; rotation/restart rebuilds become lookups "
+                        "and identical-layout pids share one blob)")
     p.add_argument("--streaming-window", action="store_true",
                    help="feed each capture drain to the aggregation device "
                         "DURING the window (perf capture + dict aggregator "
@@ -640,6 +663,19 @@ def run(argv=None) -> int:
                     1e9 / args.profiling_cpu_sampling_frequency),
                 quarantine=quarantine)
             source.on_drain = feeder.on_drain
+
+    # -- warm statics snapshot (docs/perf.md "the statics wall") -------------
+    statics_store = None
+    if args.statics_snapshot_path:
+        if not args.fast_encode:
+            log.warn("--statics-snapshot-path needs --fast-encode; "
+                     "statics snapshotting disabled")
+        else:
+            from parca_agent_tpu.pprof.statics_store import StaticsStore
+
+            statics_store = StaticsStore(
+                args.statics_snapshot_path,
+                max_age_s=args.statics_snapshot_max_age or None)
     profiler = CPUProfiler(
         source=source,
         aggregator=aggregator,
@@ -662,7 +698,21 @@ def run(argv=None) -> int:
         encode_deadline_s=args.encode_deadline or None,
         quarantine=quarantine,
         device_health=device_health,
+        statics_store=statics_store,
+        statics_snapshot_every=args.statics_snapshot_interval,
+        statics_cache_bytes=args.statics_cache_bytes,
     )
+
+    if statics_store is not None and profiler._encoder is not None:
+        # Adopt the previous run's snapshot BEFORE anything touches the
+        # aggregator or encoder: registries install only into a cold pid,
+        # and statics adoption pins the encoder's rotation epoch. A
+        # missing/stale/corrupt snapshot degrades to the plain cold
+        # build, record by record — the agent always starts.
+        adopt = statics_store.adopt(
+            aggregator, profiler._encoder,
+            int(1e9 / args.profiling_cpu_sampling_frequency))
+        log.info("statics snapshot adoption", **adopt)
 
     # -- supervision ---------------------------------------------------------
     # The reference's oklog/run group tears the process down when any
@@ -753,7 +803,8 @@ def run(argv=None) -> int:
                            extra_metrics=capture_metrics,
                            capture_info=capture_metrics,
                            supervisor=sup, quarantine=quarantine,
-                           device_health=device_health)
+                           device_health=device_health,
+                           statics_store=statics_store)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None

@@ -12,6 +12,7 @@
 // leans on Go's gzip/proto machinery for this role (pkg/profiler/pprof.go);
 // here the hot loop is native with the numpy path as a build-less fallback.
 
+#include <cstddef>
 #include <cstdint>
 
 extern "C" {
@@ -64,6 +65,29 @@ int64_t pa_put_varints_padded(uint8_t* out, int64_t out_len,
       v >>= 7;
     }
     *p = static_cast<uint8_t>(v & 0x7F);
+  }
+  return -1;
+}
+
+// Ragged byte-run copy for vec.ragged_gather: run i is
+// src[src_pos[i], src_pos[i]+lens[i]) -> dst[dst_pos[i], ...). The numpy
+// fallback pays per-ELEMENT fancy indexing (repeat + arange + gather —
+// ~3 int64 index ops per byte); the template layout's sample-prefix and
+// statics splices move tens of MB per window, where a forward memcpy
+// walk is ~20x cheaper. All positions/lengths are BYTE offsets (the
+// Python wrapper scales by itemsize). Returns -1, or the first index
+// whose run leaves either buffer — checked before any write.
+int64_t pa_ragged_copy(uint8_t* dst, int64_t dst_len, const uint8_t* src,
+                       int64_t src_len, const int64_t* src_pos,
+                       const int64_t* dst_pos, const int64_t* lens,
+                       int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    int64_t l = lens[i];
+    if (l < 0 || src_pos[i] < 0 || src_pos[i] + l > src_len ||
+        dst_pos[i] < 0 || dst_pos[i] + l > dst_len)
+      return i;
+    __builtin_memcpy(dst + dst_pos[i], src + src_pos[i],
+                     static_cast<size_t>(l));
   }
   return -1;
 }

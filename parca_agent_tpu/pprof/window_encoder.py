@@ -17,6 +17,12 @@ dict aggregator exploits for counts:
     table, period) change only when that pid's registry grows; they are
     cached as bytes and rebuilt incrementally (location growth appends to
     the cached location section without touching the rest).
+  * Static sections are additionally CONTENT-ADDRESSED (_ContentCache):
+    built blobs are interned under a digest of their build inputs, so a
+    registry rotation or an encoder reset — which wipe the per-pid map —
+    rebuilds by lookup instead of re-encoding, pids with identical inputs
+    (forks, same-image containers) share one blob, and a restart warmed
+    through pprof/statics_store.py adopts blobs straight into the cache.
 
 Steady state — stationary stack population — therefore costs one ragged
 byte gather plus one varint pass over the live ids, independent of how the
@@ -59,6 +65,7 @@ Thread-ownership contract (the encode pipeline, profiler/encode_pipeline.py):
 from __future__ import annotations
 
 import gzip as _gzip
+import hashlib as _hashlib
 
 import numpy as np
 
@@ -175,18 +182,99 @@ def _encode_mapping_stream(mids, starts, limits, offsets, fidx, bidx):
 
 
 class _PidStatic:
-    """Cached per-pid static sections of the profile message."""
+    """Cached per-pid static sections of the profile message.
+
+    loc_bytes is `bytes` while the section is a pure content-cache value
+    (possibly SHARED across pids — cross-pid dedup) and is promoted to a
+    private bytearray by _loc_extend the first time this pid appends a
+    delta past the shared prefix."""
 
     __slots__ = ("head", "loc_bytes", "tail", "n_mappings", "n_locs",
-                 "period_ns")
+                 "period_ns", "reg")
 
     def __init__(self):
         self.head = b""          # sample_type + mapping messages
-        self.loc_bytes = bytearray()  # location messages (append-only)
+        self.loc_bytes = b""     # location messages (append-only)
         self.tail = b""          # string table + period_type + period
         self.n_mappings = -1
         self.n_locs = 0
         self.period_ns = -1      # period embedded in tail (staleness guard)
+        self.reg = None          # registry these sections were built from
+        #                          (identity guard for the rotation-time
+        #                          cache rescue: a reused pid number with
+        #                          a FRESH registry must not intern the
+        #                          old pid's bytes under new-content keys)
+
+
+def _loc_extend(st: _PidStatic, data) -> None:
+    """Append location bytes, promoting a shared cached blob to a private
+    bytearray first (cache values are immutable and may be aliased by
+    other pids)."""
+    if not isinstance(st.loc_bytes, bytearray):
+        st.loc_bytes = bytearray(st.loc_bytes)
+    st.loc_bytes.extend(data)
+
+
+def _ht_key(reg, n_mappings: int, period_ns: int) -> bytes:
+    """Content digest of the head/tail build inputs: the first n_mappings
+    registry mappings plus the period. Everything the built bytes depend
+    on — and nothing else — so equal keys mean byte-equal sections."""
+    h = _hashlib.blake2b(digest_size=16)
+    h.update(b"H%d,%d;" % (period_ns, n_mappings))
+    for m in reg.mappings[:n_mappings]:
+        h.update(("%d,%d,%d,%d,%s\0%s\0" % (
+            m.id, m.start, m.end, m.offset, m.path, m.build_id)).encode())
+    return b"H" + h.digest()
+
+
+def _loc_key(reg, n_locs: int) -> bytes:
+    """Content digest of a FULL location blob's build inputs: rows
+    [0, n_locs) of (mapping id, normalized address) — ids are always the
+    dense 1-based numbering, so they are implied by n_locs."""
+    h = _hashlib.blake2b(digest_size=16)
+    h.update(n_locs.to_bytes(8, "little"))
+    h.update(np.asarray(reg.loc_mapping_id[:n_locs], np.uint64).tobytes())
+    h.update(np.asarray(reg.loc_normalized[:n_locs], np.uint64).tobytes())
+    return b"L" + h.digest()
+
+
+class _ContentCache:
+    """Content-addressed interning of built statics sections.
+
+    Keys digest the build INPUTS (_ht_key/_loc_key); values are the built
+    bytes. Because keys name content — not pids — the cache survives the
+    events that wipe the per-pid statics map wholesale (registry
+    rotation, encoder reset, a restart warmed through the statics store),
+    turning those rebuild storms into lookups, and pids with identical
+    inputs (forks, same-image containers) share one value (cross-pid
+    dedup). Insertion-order LRU, bounded by value bytes."""
+
+    __slots__ = ("_map", "max_bytes", "bytes", "evictions")
+
+    def __init__(self, max_bytes: int):
+        self._map: dict[bytes, tuple[object, int]] = {}
+        self.max_bytes = max_bytes
+        self.bytes = 0
+        self.evictions = 0
+
+    def get(self, key: bytes):
+        got = self._map.pop(key, None)
+        if got is None:
+            return None
+        self._map[key] = got  # re-insert: recency order
+        return got[0]
+
+    def put(self, key: bytes, value, nbytes: int) -> None:
+        if key in self._map or nbytes > self.max_bytes:
+            return
+        self._map[key] = (value, nbytes)
+        self.bytes += nbytes
+        while self.bytes > self.max_bytes and self._map:
+            # dict order = insertion/recency order (get re-inserts), so
+            # the first key is the least recently used.
+            _, sz = self._map.pop(next(iter(self._map)))
+            self.bytes -= sz
+            self.evictions += 1
 
 
 class _Template:
@@ -272,10 +360,13 @@ def _reg_cap(reg) -> tuple:
     """(registry, safe mapping count, safe location count) for concurrent
     readers: the loc lists are extended address-first, so the minimum of
     the three lengths is complete in all of them, and mappings are
-    appended BEFORE any location row references them."""
-    return (reg, len(reg.mappings),
-            min(len(reg.loc_address), len(reg.loc_normalized),
-                len(reg.loc_mapping_id)))
+    appended BEFORE any location row references them — which is only a
+    guarantee if the LOCATION lengths are read first (reading the
+    mapping count first could miss a mapping that location rows read a
+    moment later already reference)."""
+    n_locs = min(len(reg.loc_address), len(reg.loc_normalized),
+                 len(reg.loc_mapping_id))
+    return (reg, len(reg.mappings), n_locs)
 
 
 _WTAIL_LEN = 22  # [tag][10B time][tag][10B duration], fixed-width
@@ -305,9 +396,15 @@ class WindowEncoder:
     _VAL_W = 5    # fixed-width count varint: covers the int32 window bound
     _TIME_W = 10  # fixed-width time/duration varint: covers any uint64
 
-    def __init__(self, agg, compress: bool = False):
+    def __init__(self, agg, compress: bool = False,
+                 statics_cache_bytes: int = 256 << 20):
         self._agg = agg
         self._compress = compress
+        # Content-addressed statics interning (digest of build inputs ->
+        # built bytes): survives rotation/reset/adoption, dedups across
+        # pids. Sized generously — values alias the per-pid sections, so
+        # the marginal footprint is only the cross-content variety.
+        self._cache = _ContentCache(statics_cache_bytes)
         self._synced = 0                 # ids with cached sample prefixes
         self._rotations = -1             # aggregator rotation epoch mirror
         self._pre_flat = np.empty(4096, np.uint8)
@@ -318,6 +415,11 @@ class WindowEncoder:
         self._order = None               # ids sorted by pid (int64)
         self._order_pid = None           # pid per sorted slot (int32)
         self._static: dict[int, _PidStatic] = {}
+        # (registry version, period) after a scan that found NOTHING
+        # dirty: while the aggregator reports the same version, the
+        # O(pids) staleness scan in build_statics/statics_backlog is
+        # provably a no-op and is skipped (it used to run per drain).
+        self._statics_clean: tuple | None = None
         self._tmpl = _Template()
         self.timings: dict[str, float] = {}
         # Per-encode observability (ADVICE round 5): the churn-tolerant
@@ -330,7 +432,45 @@ class WindowEncoder:
             "template_rows": 0,
             "dead_rows": 0,
             "dead_row_fraction": 0.0,
+            # Content-addressed statics accounting: hits/misses count
+            # cache lookups; built/reused count the section BYTES that
+            # were vectorized-encoded vs served from the cache (the dedup
+            # ratio is reused / (built + reused)); append_fast/slow count
+            # churn-append pid groups by path.
+            "statics_cache_hits": 0,
+            "statics_cache_misses": 0,
+            "statics_cache_bytes": 0,
+            "statics_cache_evictions": 0,
+            "statics_bytes_built": 0,
+            "statics_bytes_reused": 0,
+            "statics_dedup_ratio": 0.0,
+            "statics_adopted_pids": 0,
+            "append_fast_groups": 0,
+            "append_slow_groups": 0,
         }
+
+    # -- content cache -------------------------------------------------------
+
+    def _cache_get(self, key: bytes):
+        got = self._cache.get(key)
+        if got is None:
+            self.stats["statics_cache_misses"] += 1
+            return None
+        self.stats["statics_cache_hits"] += 1
+        return got
+
+    def _cache_put(self, key: bytes, value, nbytes: int) -> None:
+        self._cache.put(key, value, nbytes)
+        self.stats["statics_cache_bytes"] = self._cache.bytes
+        self.stats["statics_cache_evictions"] = self._cache.evictions
+
+    def _count_statics_bytes(self, built: int = 0, reused: int = 0) -> None:
+        self.stats["statics_bytes_built"] += built
+        self.stats["statics_bytes_reused"] += reused
+        total = (self.stats["statics_bytes_built"]
+                 + self.stats["statics_bytes_reused"])
+        self.stats["statics_dedup_ratio"] = (
+            self.stats["statics_bytes_reused"] / total if total else 0.0)
 
     # -- mirrors -------------------------------------------------------------
 
@@ -343,11 +483,26 @@ class WindowEncoder:
         agg = self._agg
         rot = agg.stats.get("rotations", 0)
         if rot != self._rotations:
-            # Rotation remapped ids wholesale: drop every mirror.
+            # Rotation remapped ids wholesale: drop every mirror. But
+            # first rescue the location blobs into the content cache —
+            # rotation never edits a surviving pid's registry content, so
+            # the blobs are still exact and the imminent rebuild can be
+            # lookups instead of re-encodes. (Head/tail pairs were cached
+            # at build time; delta-extended loc blobs were not.)
+            if self._rotations >= 0:
+                for pid, st in self._static.items():
+                    reg = agg._pids.get(pid)
+                    if (reg is None or reg is not st.reg
+                            or st.n_locs == 0
+                            or len(reg.loc_mapping_id) < st.n_locs):
+                        continue
+                    self._cache_put(_loc_key(reg, st.n_locs),
+                                    bytes(st.loc_bytes), len(st.loc_bytes))
             self._rotations = rot
             self._synced = 0
             self._pre_off[0] = 0
             self._static.clear()
+            self._statics_clean = None
             self._order = None
         n = getattr(agg, "_published", None)
         if n is None:
@@ -361,13 +516,17 @@ class WindowEncoder:
         """Drop every mirror, cached static, and the template; the next
         encode rebuilds from the aggregator's registry. For recovery after
         an encode aborted mid-flight (encoder-thread exception) left the
-        template state inconsistent."""
+        template state inconsistent. The CONTENT cache deliberately
+        survives: its values are immutable bytes keyed by input digests —
+        an aborted encode cannot have corrupted them, and they are what
+        makes the post-reset rebuild cheap."""
         self._synced = 0
         self._rotations = -1
         self._pre_off[0] = 0
         self._order = None
         self._order_pid = None
         self._static.clear()
+        self._statics_clean = None
         self._tmpl = _Template()
 
     def _ensure_order(self) -> None:
@@ -433,6 +592,14 @@ class WindowEncoder:
         for encoder-thread callers (a concurrent feed may be appending)."""
         if n_mappings is None:
             n_mappings = len(reg.mappings)
+        key = _ht_key(reg, n_mappings, period_ns)
+        got = self._cache_get(key)
+        if got is not None:
+            st.head, st.tail = got
+            st.n_mappings = n_mappings
+            st.period_ns = period_ns
+            self._count_statics_bytes(reused=len(st.head) + len(st.tail))
+            return
         strings = _Strings()
         w = proto.Writer()
         vt = proto.Writer().varint(VT_TYPE, strings("samples")) \
@@ -460,6 +627,8 @@ class WindowEncoder:
         st.tail = bytes(tail)
         st.n_mappings = n_mappings
         st.period_ns = period_ns
+        self._cache_put(key, (st.head, st.tail), len(st.head) + len(st.tail))
+        self._count_statics_bytes(built=len(st.head) + len(st.tail))
 
     def _ensure_static(self, pid: int, period_ns: int,
                        cap: tuple | None = None) -> _PidStatic:
@@ -474,17 +643,35 @@ class WindowEncoder:
         st = self._static.get(pid)
         if st is None:
             st = self._static[pid] = _PidStatic()
+        st.reg = reg
         if st.n_mappings < n_mappings or st.period_ns != period_ns:
             self._build_head_tail(st, reg, period_ns,
                                   max(n_mappings, st.n_mappings))
         if st.n_locs < n_locs:
+            key = None
+            if st.n_locs == 0:
+                # Full blob: content-addressable (post-rotation rebuilds
+                # and restart adoption land here with a warm cache).
+                key = _loc_key(reg, n_locs)
+                got = self._cache_get(key)
+                if got is not None:
+                    st.loc_bytes = got
+                    st.n_locs = n_locs
+                    self._count_statics_bytes(reused=len(got))
+                    return st
             ids = np.arange(st.n_locs + 1, n_locs + 1, dtype=np.uint64)
             mids = np.asarray(reg.loc_mapping_id[st.n_locs:n_locs],
                               np.uint64)
             addrs = np.asarray(reg.loc_normalized[st.n_locs:n_locs],
                                np.uint64)
             buf, _ = _encode_location_stream(ids, mids, addrs)
-            st.loc_bytes.extend(buf.tobytes())
+            data = buf.tobytes()
+            self._count_statics_bytes(built=len(data))
+            if key is not None:
+                st.loc_bytes = data
+                self._cache_put(key, data, len(data))
+            else:
+                _loc_extend(st, data)
             st.n_locs = n_locs
         return st
 
@@ -555,7 +742,33 @@ class WindowEncoder:
         the batch encode in vectorized passes (the scalar path's
         per-message Writer varints dominated the 50k-pid first build).
         Items are (static, registry, n_mappings) with the mapping count
-        frozen by the caller (encoder-thread safety)."""
+        frozen by the caller (encoder-thread safety).
+
+        Cache-aware: items whose build inputs digest to a cached pair are
+        served directly (a rotation or restart rebuilds thousands of pids
+        whose content did not change; pids sharing a layout dedup to one
+        build); only the residue pays the vectorized encode."""
+        keyed = [(it, _ht_key(it[1], it[2], period_ns)) for it in items]
+        items = []
+        dups: dict[bytes, list] = {}  # within-batch identical layouts
+        for it, key in keyed:
+            if key in dups:
+                dups[key].append(it)
+                continue
+            got = self._cache_get(key)
+            if got is None:
+                items.append((it, key))
+                dups[key] = []
+                continue
+            st = it[0]
+            st.head, st.tail = got
+            st.n_mappings = it[2]
+            st.period_ns = period_ns
+            self._count_statics_bytes(reused=len(st.head) + len(st.tail))
+        if not items:
+            return
+        keys = [key for _, key in items]
+        items = [it for it, _ in items]
         mid: list[int] = []
         start: list[int] = []
         limit: list[int] = []
@@ -598,35 +811,80 @@ class WindowEncoder:
             st.tail = tails[k]
             st.period_ns = period_ns
             st.n_mappings = nm
+            self._cache_put(keys[k], (st.head, st.tail),
+                            len(st.head) + len(st.tail))
+            self._count_statics_bytes(built=len(st.head) + len(st.tail))
+            for st2, _reg2, nm2 in dups.get(keys[k], ()):
+                # Same inputs elsewhere in this batch: share the blobs.
+                st2.head, st2.tail = st.head, st.tail
+                st2.period_ns = period_ns
+                st2.n_mappings = nm2
+                self._count_statics_bytes(reused=len(st.head)
+                                          + len(st.tail))
 
     def _build_locs_batch(self, dirty) -> None:
         """One vectorized location pass over a batch of (static, registry,
-        n_locs) triples whose cached location sections are behind."""
+        n_locs) triples whose cached location sections are behind.
+
+        Full blobs (n_locs building from 0 — the rotation-rebuild and
+        restart-adoption shape) are content-addressed: a cache hit skips
+        the varint encode entirely and aliases the shared bytes; only
+        misses and true deltas ride the batch encode below."""
         from itertools import chain
 
-        lens = np.array([n - st.n_locs for st, reg, n in dirty], np.int64)
+        rest: list[tuple] = []  # (st, reg, n, full_blob_key_or_None)
+        dups: dict[bytes, list] = {}  # within-batch identical blobs
+        for st, reg, n in dirty:
+            if st.n_locs == 0 and n > 0:
+                key = _loc_key(reg, n)
+                if key in dups:
+                    dups[key].append((st, n))
+                    continue
+                got = self._cache_get(key)
+                if got is not None:
+                    st.loc_bytes = got
+                    st.n_locs = n
+                    self._count_statics_bytes(reused=len(got))
+                    continue
+                dups[key] = []
+                rest.append((st, reg, n, key))
+            else:
+                rest.append((st, reg, n, None))
+        if not rest:
+            return
+        lens = np.array([n - st.n_locs for st, reg, n, _ in rest], np.int64)
         total = int(lens.sum())
-        bounds = np.zeros(len(dirty) + 1, np.int64)
+        bounds = np.zeros(len(rest) + 1, np.int64)
         np.cumsum(lens, out=bounds[1:])
         # Flat streams without 10k+ intermediate per-pid arrays: ids are
         # each pid's 1-based location numbering continued from its cache.
-        first = np.array([st.n_locs + 1 for st, reg, n in dirty], np.uint64)
+        first = np.array([st.n_locs + 1 for st, reg, n, _ in rest],
+                         np.uint64)
         ids = np.repeat(first, lens) + (
             np.arange(total, dtype=np.uint64)
             - np.repeat(bounds[:-1], lens).astype(np.uint64))
         mids = np.fromiter(
             chain.from_iterable(reg.loc_mapping_id[st.n_locs:n]
-                                for st, reg, n in dirty),
+                                for st, reg, n, _ in rest),
             np.uint64, total)
         addrs = np.fromiter(
             chain.from_iterable(reg.loc_normalized[st.n_locs:n]
-                                for st, reg, n in dirty),
+                                for st, reg, n, _ in rest),
             np.uint64, total)
         buf, offs = _encode_location_stream(ids, mids, addrs)
         mv = buf.data
-        for k, (st, reg, n) in enumerate(dirty):
-            st.loc_bytes.extend(
-                mv[int(offs[bounds[k]]): int(offs[bounds[k + 1]])])
+        for k, (st, reg, n, key) in enumerate(rest):
+            data = mv[int(offs[bounds[k]]): int(offs[bounds[k + 1]])]
+            self._count_statics_bytes(built=len(data))
+            if key is not None:
+                st.loc_bytes = bytes(data)
+                self._cache_put(key, st.loc_bytes, len(st.loc_bytes))
+                for st2, n2 in dups.get(key, ()):
+                    st2.loc_bytes = st.loc_bytes
+                    st2.n_locs = n2
+                    self._count_statics_bytes(reused=len(st.loc_bytes))
+            else:
+                _loc_extend(st, data)
             st.n_locs = n
 
     def build_statics(self, period_ns: int, budget_s: float | None = None,
@@ -660,6 +918,16 @@ class WindowEncoder:
 
         t0 = _time.perf_counter()
         self._sync()
+        agg = self._agg
+        version = (getattr(agg, "_reg_version", None), period_ns)
+        if version[0] is not None and self._statics_clean == version:
+            # Nothing can be dirty: no registry mutated since a scan
+            # that found everything clean at this period. Skips the
+            # O(pids) staleness walk this method otherwise pays on
+            # every drain-tick prebuild and every encode.
+            if prepare_order:
+                self._ensure_order()
+            return len(agg._pids) if caps is None else len(caps)
         if prepare_order:
             # Pipeline prebuilds run on the WORKER thread: rebuilding the
             # stale pid sort order here moves the O(n log n) argsort over
@@ -668,7 +936,6 @@ class WindowEncoder:
             # tick). Inline callers keep the lazy default — on the
             # polling thread that argsort per drain would be pure loss.
             self._ensure_order()
-        agg = self._agg
         if caps is not None:
             targets = [(pid, cap) for pid, cap in caps.items()]
         else:
@@ -682,6 +949,7 @@ class WindowEncoder:
             st = self._static.get(pid)
             if st is None:
                 st = self._static[pid] = _PidStatic()
+            st.reg = reg
             if st.n_mappings < nm or st.period_ns != period_ns:
                 dirty_ht.append((st, reg, max(nm, st.n_mappings)))
             if st.n_locs < nl:
@@ -717,6 +985,12 @@ class WindowEncoder:
             self._build_locs_batch(dirty[k: end])
             did_work = True
             k = end
+        if caps is None and not left and version[0] is not None:
+            # Full-target scan came back (or was built) clean: the next
+            # call at this (version, period) can skip the walk. The
+            # version was read BEFORE the scan, so a concurrent insert
+            # landing mid-walk re-arms the scan on the next call.
+            self._statics_clean = version
         return len(targets) - len(left)
 
     def statics_backlog(self, period_ns: int) -> int:
@@ -725,6 +999,9 @@ class WindowEncoder:
         progress gauge. Call only from a thread that owns the encoder
         (same contract as prepare)."""
         self._sync()
+        if self._statics_clean == (getattr(self._agg, "_reg_version",
+                                           None), period_ns):
+            return 0
         n = 0
         for _pid, reg in list(self._agg._pids.items()):
             st = self._static.get(_pid)
@@ -733,6 +1010,40 @@ class WindowEncoder:
                     or st.period_ns != period_ns or st.n_locs < nl:
                 n += 1
         return n
+
+    def adopt_statics(self, pid: int, head: bytes, tail: bytes,
+                      loc_bytes: bytes, n_mappings: int, n_locs: int,
+                      period_ns: int) -> None:
+        """Install snapshot-restored static sections for one pid (the
+        statics store's warm-restart path, pprof/statics_store.py). The
+        caller has already validated the blobs against the pid's adopted
+        registry content and installed that registry in the aggregator.
+        Must run before any encode/prebuild touches the pid — i.e. at
+        startup, on the thread that owns the encoder.
+
+        The head/tail pair is also interned into the content cache under
+        its input digest (cheap: a handful of mapping rows). Location
+        blobs are NOT digested here — adoption is on the startup path
+        and already pays one content digest per record for validation;
+        the rotation-time rescue in _sync interns them lazily, exactly
+        when a rebuild could want them."""
+        self._sync()  # pin the rotation epoch so the next sync keeps these
+        st = self._static.get(pid)
+        if st is None:
+            st = self._static[pid] = _PidStatic()
+        st.head = head
+        st.tail = tail
+        st.loc_bytes = loc_bytes
+        st.n_mappings = n_mappings
+        st.n_locs = n_locs
+        st.period_ns = period_ns
+        self.stats["statics_adopted_pids"] += 1
+        reg = self._agg._pids.get(pid)
+        st.reg = reg
+        if reg is None:
+            return
+        self._cache_put(_ht_key(reg, n_mappings, period_ns), (head, tail),
+                        len(head) + len(tail))
 
     # -- encode --------------------------------------------------------------
 
@@ -795,13 +1106,18 @@ class WindowEncoder:
         buf[vp + 1] = self._VAL_W
 
         time_pos = blob_start + samples_per_g + static_lens
-        for g, s in enumerate(statics):
-            a = int(blob_start[g] + samples_per_g[g])
-            for part in (s.head, s.loc_bytes, s.tail):
-                lp = len(part)
-                if lp:
-                    buf[a: a + lp] = np.frombuffer(part, np.uint8)
-                    a += lp
+        # Statics splice: one C-speed join into a flat buffer, then one
+        # ragged scatter (native: a memcpy per pid) — the old path paid
+        # 3 numpy slice copies per pid, tens of thousands of Python
+        # iterations on the exact window the cold-start cliff hits.
+        joined = np.frombuffer(
+            b"".join(part for s in statics
+                     for part in (s.head, s.loc_bytes, s.tail)), np.uint8)
+        src_off = np.zeros(len(statics) + 1, np.int64)
+        np.cumsum(static_lens, out=src_off[1:])
+        if len(joined):
+            ragged_gather(joined, src_off[:-1], static_lens, out=buf,
+                          out_starts=blob_start + samples_per_g)
         buf[time_pos] = (P_TIME_NANOS << 3)
         buf[time_pos + 1 + self._TIME_W] = (P_DURATION_NANOS << 3)
 
@@ -869,45 +1185,98 @@ class WindowEncoder:
         append-only delta) go into the owning pid's slack; a pid without
         room — or whose head/tail statics changed — relocates its blob to
         the buffer's end (blob order is meaningless); a brand-new pid gets
-        a fresh blob. encode() patches every count afterwards."""
+        a fresh blob. encode() patches every count afterwards.
+
+        The dominant churn shape — existing pid, statics unchanged, rows
+        fit in slack — is handled for ALL such groups in one vectorized
+        scatter (the per-group loop at 10k churning pids was most of the
+        churn-encode penalty); only exceptional groups (statics drift,
+        slack exhaustion, brand-new pids) take the scalar walk."""
         tmpl = self._tmpl
         # Batch-build dirty statics first (new stacks usually mean new
         # locations for their pids); the per-pid _ensure_static below is
-        # then a cache hit — the same reasoning as _build_layout's.
-        self.build_statics(period_ns, caps=caps)
+        # then a cache hit — the same reasoning as _build_layout's. Only
+        # the APPENDING pids are targeted: freshening every registry pid
+        # here cost an O(all pids) staleness walk per churn window.
+        pids_u = [int(p) for p in np.unique(new_pids).tolist()]
+        if caps is None:
+            sub = {p: _reg_cap(self._agg._pids[p]) for p in pids_u
+                   if p in self._agg._pids}
+        else:
+            sub = {p: caps[p] for p in pids_u if p in caps}
+        self.build_statics(period_ns, caps=sub)
         stream, s_off, vp_rel = self._serialize_rows(new_ids)
         bounds = np.flatnonzero(np.diff(new_pids)) + 1
-        gstarts = np.concatenate(([0], bounds)).tolist()
-        gends = np.concatenate((bounds, [len(new_ids)])).tolist()
+        gstarts = np.concatenate(([0], bounds))
+        gends = np.concatenate((bounds, [len(new_ids)]))
         n0 = tmpl.n_rows
         add_val_pos = np.empty(len(new_ids), np.int64)
         add_group = np.empty(len(new_ids), np.int32)
+        n_g = len(gstarts)
+        statics = [self._ensure_static(int(new_pids[gs]), period_ns,
+                                       cap=None if caps is None
+                                       else caps.get(int(new_pids[gs])))
+                   for gs in gstarts.tolist()]
+        g_idx = np.full(n_g, -1, np.int64)
+        fast = np.zeros(n_g, bool)
+        for k in range(n_g):
+            st = statics[k]
+            g = tmpl.group_of.get(int(new_pids[gstarts[k]]))
+            if g is None:
+                continue
+            g_idx[k] = g
+            fast[k] = (len(st.head) == int(tmpl.g_head_len[g])
+                       and len(st.tail) == int(tmpl.g_tail_len[g])
+                       and len(st.loc_bytes) == int(tmpl.g_loc_len[g]))
+        need = s_off[gends] - s_off[gstarts]
+        kf = np.flatnonzero(fast)
+        if len(kf):
+            gf = g_idx[kf]
+            room = (tmpl.cap_end[gf] - tmpl.blob_end[gf]) >= need[kf]
+            fast[kf[~room]] = False
+            kf, gf = kf[room], gf[room]
+        if len(kf):
+            dest = tmpl.blob_end[gf].copy()
+            ragged_gather(stream, s_off[gstarts[kf]], need[kf],
+                          out=tmpl.buf, out_starts=dest)
+            tmpl.blob_end[gf] = dest + need[kf]
+            sizes = (gends - gstarts)[kf]
+            tot = int(sizes.sum())
+            off = np.zeros(len(kf) + 1, np.int64)
+            np.cumsum(sizes, out=off[1:])
+            rows_flat = np.repeat(gstarts[kf], sizes) + (
+                np.arange(tot, dtype=np.int64) - np.repeat(off[:-1], sizes))
+            shift = dest - s_off[gstarts[kf]]
+            add_val_pos[rows_flat] = vp_rel[rows_flat] + np.repeat(shift,
+                                                                  sizes)
+            add_group[rows_flat] = np.repeat(gf, sizes).astype(np.int32)
+        self.stats["append_fast_groups"] += len(kf)
+        self.stats["append_slow_groups"] += n_g - len(kf)
         pend: list[tuple] = []  # deferred new-group records (pid, blob
         #                         geometry) — one concatenate per array
         #                         after the loop, not one np.append each
-        for gs, ge in zip(gstarts, gends):
+        for k in np.flatnonzero(~fast).tolist():
+            gs, ge = int(gstarts[k]), int(gends[k])
             pid = int(new_pids[gs])
-            st = self._ensure_static(pid, period_ns,
-                                     cap=None if caps is None
-                                     else caps.get(pid))
+            st = statics[k]
             g = tmpl.group_of.get(pid)
             lo, hi = int(s_off[gs]), int(s_off[ge])
             if g is not None \
                     and len(st.head) == int(tmpl.g_head_len[g]) \
                     and len(st.tail) == int(tmpl.g_tail_len[g]):
                 loc_delta = len(st.loc_bytes) - int(tmpl.g_loc_len[g])
-                need = (hi - lo) + loc_delta
-                if tmpl.cap_end[g] - tmpl.blob_end[g] < need:
-                    self._relocate_blob(g, need)
+                need_g = (hi - lo) + loc_delta
+                if tmpl.cap_end[g] - tmpl.blob_end[g] < need_g:
+                    self._relocate_blob(g, need_g)
                 dest = int(tmpl.blob_end[g])
                 buf = tmpl.buf
                 buf[dest: dest + (hi - lo)] = stream[lo:hi]
                 if loc_delta:
-                    buf[dest + (hi - lo): dest + need] = np.frombuffer(
+                    buf[dest + (hi - lo): dest + need_g] = np.frombuffer(
                         st.loc_bytes, np.uint8,
                         loc_delta, int(tmpl.g_loc_len[g]))
                     tmpl.g_loc_len[g] += loc_delta
-                tmpl.blob_end[g] += need
+                tmpl.blob_end[g] += need_g
                 add_val_pos[gs:ge] = dest + (vp_rel[gs:ge] - lo)
             else:
                 # Head/tail changed (mapping growth, comm change) or a

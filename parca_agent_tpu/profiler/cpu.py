@@ -85,6 +85,9 @@ class CPUProfiler:
         encode_deadline_s: float | None = None,
         quarantine=None,
         device_health=None,
+        statics_store=None,
+        statics_snapshot_every: int = 6,
+        statics_cache_bytes: int = 256 << 20,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -112,7 +115,8 @@ class CPUProfiler:
                     "(window_counts/close_window protocol)")
             from parca_agent_tpu.pprof.window_encoder import WindowEncoder
 
-            self._encoder = WindowEncoder(aggregator)
+            self._encoder = WindowEncoder(
+                aggregator, statics_cache_bytes=statics_cache_bytes)
         # Encode pipeline: window close hands the aggregated counts to a
         # dedicated encoder thread, so capture of window N+1 overlaps
         # encoding/shipping of window N and the encoder's slow transients
@@ -121,6 +125,13 @@ class CPUProfiler:
         # slower than encode_deadline_s is abandoned to a daemon thread
         # and the window ships via the scalar fallback.
         self._pipeline = None
+        # Warm statics + registry snapshot (pprof/statics_store.py): the
+        # encode worker persists the statics state on the window clock so
+        # a restart adopts instead of cold-building; the capture thread
+        # never touches the file. Snapshotting therefore requires the
+        # pipeline — without a worker there is no thread that may safely
+        # serialize the encoder's statics map off the capture path.
+        self._statics_store = statics_store
         if encode_pipeline:
             if self._encoder is None:
                 raise ValueError("encode_pipeline requires fast_encode")
@@ -128,8 +139,18 @@ class CPUProfiler:
                 EncodePipeline,
             )
 
-            self._pipeline = EncodePipeline(self._encoder,
-                                            ship=self._ship_encoded)
+            snapshot = None
+            if statics_store is not None:
+                snapshot = (lambda period_ns: statics_store.save(
+                    self._aggregator, self._encoder, period_ns))
+            self._pipeline = EncodePipeline(
+                self._encoder, ship=self._ship_encoded,
+                snapshot=snapshot,
+                snapshot_every=(statics_snapshot_every
+                                if statics_store is not None else 0))
+        elif statics_store is not None:
+            _log.warn("statics snapshotting needs the encode pipeline; "
+                      "snapshots disabled (adoption still works)")
         self._encode_deadline = encode_deadline_s
         self._encode_inflight = None   # abandoned inline deadline encode
         self._encode_abandoned = None  # its result box (error inspection)

@@ -25,6 +25,11 @@ This pipeline moves encode + ship onto a dedicated worker thread:
   * A worker exception ships the failed window through the caller's
     fallback, resets the encoder's mirrors, and disables the pipeline —
     the profiler reverts to its inline path; no window is lost.
+  * The warm statics snapshot (pprof/statics_store.py) also rides this
+    worker: every snapshot_every-th shipped window, the worker serializes
+    the registry + statics state so a restart adopts instead of
+    rebuilding. Worker-thread-only by design — the snapshot reads the
+    same encoder state prebuilds do, and must never stall capture.
   * close() flushes the in-flight window before stopping the worker, so
     a draining agent ships everything it aggregated.
 """
@@ -52,11 +57,20 @@ class EncodePipeline:
     """
 
     def __init__(self, encoder, ship, ship_views: bool = True,
-                 name: str = THREAD_NAME):
+                 name: str = THREAD_NAME, snapshot=None,
+                 snapshot_every: int = 0):
         self._enc = encoder
         self._ship = ship
         self._views = ship_views
         self._name = name
+        # Warm statics snapshot hook (pprof/statics_store.py): a
+        # `snapshot(period_ns)` callable run on THIS worker thread after
+        # every snapshot_every-th shipped window — the one thread that
+        # may read the encoder's statics map, and by construction never
+        # the capture thread. A snapshot failure is counted, never fatal
+        # (the agent just stays cold-restartable one interval longer).
+        self._snapshot = snapshot
+        self._snapshot_every = snapshot_every
         self._cond = threading.Condition()
         self._window = None          # pending (prep, fallback) hand-off
         self._prebuild = None        # latest coalesced (period_ns, budget_s)
@@ -78,6 +92,9 @@ class EncodePipeline:
             "last_encode_s": 0.0,
             "last_ship_s": 0.0,
             "overlap_s_total": 0.0,
+            "snapshots_written": 0,
+            "snapshot_errors": 0,
+            "last_snapshot_s": 0.0,
         }
 
     # -- profiler-thread API -------------------------------------------------
@@ -272,6 +289,31 @@ class EncodePipeline:
             return
         self.stats["last_ship_s"] = time.perf_counter() - t0
         self.stats["windows_pipelined"] += 1
+        if self._snapshot is not None and self._snapshot_every > 0 \
+                and self.stats["windows_pipelined"] \
+                % self._snapshot_every == 0:
+            # Warm statics snapshot on the window clock, on this worker
+            # thread, AFTER the ship — so a failed snapshot can neither
+            # delay nor duplicate the window. Errors are contained here:
+            # letting one escape would read as an encoder death and
+            # disable the pipeline over a disk hiccup.
+            t0 = time.perf_counter()
+            try:
+                # The store's save() reports failure as False and a
+                # clean skip (disk already current) as "skipped" — only
+                # a real write counts as written, so this gauge stays in
+                # lockstep with the store's own snapshots_written. The
+                # except arm covers custom callables.
+                r = self._snapshot(prep.period_ns)
+                if r is False:
+                    self.stats["snapshot_errors"] += 1
+                elif r != "skipped":
+                    self.stats["snapshots_written"] += 1
+            except Exception as e:  # noqa: BLE001 - snapshot is best-effort
+                self.stats["snapshot_errors"] += 1
+                _log.warn("statics snapshot failed on the encode worker",
+                          error=repr(e))
+            self.stats["last_snapshot_s"] = time.perf_counter() - t0
 
     def _fail_window(self, e: Exception, fallback) -> None:
         """Worker died on a window: disable the pipeline (the profiler

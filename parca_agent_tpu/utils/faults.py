@@ -11,6 +11,10 @@ layer the ship-path components consult at NAMED SITES:
     writer.write      local-store profile write (disk_full)
     batch.flush       one flush attempt of the batch client
     actor.<name>      a supervised actor's loop tick (crash)
+    statics.snapshot  warm statics+registry snapshot write
+                      (pprof/statics_store.py; disk_full/error — a
+                      failed snapshot is counted and skipped, the
+                      window it followed is already shipped)
 
 and, on the ingest side (docs/robustness.md "ingest containment" — the
 ``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an

@@ -58,7 +58,7 @@ def render_status_page(profilers, version: str = "dev",
 
 def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    supervisor=None, quarantine=None,
-                   device_health=None) -> str:
+                   device_health=None, statics_store=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics."""
     lines = []
@@ -180,6 +180,21 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
         emit("parca_agent_device_trips", snap["trips"])
         for k, v in snap["stats"].items():
             emit(f"parca_agent_device_{k}", v)
+    if statics_store is not None:
+        # Warm-statics snapshot observability (docs/perf.md "the statics
+        # wall"): write/adopt outcome counters plus the file's age and
+        # size, so a fleet can alert on agents whose restart warmth has
+        # gone stale or whose snapshot writes are failing. The encoder's
+        # content-cache hit/dedup gauges ride the parca_agent_encoder_*
+        # loop above.
+        for k, v in statics_store.stats.items():
+            emit(f"parca_agent_statics_{k}",
+                 round(v, 3) if isinstance(v, float) else v)
+        info = statics_store.snapshot_info()
+        emit("parca_agent_statics_snapshot_present", int(info["present"]))
+        emit("parca_agent_statics_snapshot_file_bytes", info["bytes"])
+        if info["age_s"] is not None:
+            emit("parca_agent_statics_snapshot_age_seconds", info["age_s"])
     for k, v in (extra or {}).items():
         emit(k, v)
     return "\n".join(lines) + "\n"
@@ -190,7 +205,7 @@ class AgentHTTPServer:
                  profilers=(), batch_client=None, listener=None,
                  version: str = "dev", extra_metrics=None,
                  capture_info=None, supervisor=None, quarantine=None,
-                 device_health=None):
+                 device_health=None, statics_store=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -217,7 +232,8 @@ class AgentHTTPServer:
                         outer.profilers, outer.batch_client, extra,
                         supervisor=outer.supervisor,
                         quarantine=outer.quarantine,
-                        device_health=outer.device_health).encode())
+                        device_health=outer.device_health,
+                        statics_store=outer.statics_store).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
@@ -279,12 +295,16 @@ class AgentHTTPServer:
                               if outer.quarantine is not None else None)
                 device = (outer.device_health.snapshot()
                           if outer.device_health is not None else None)
+                statics = (outer.statics_store.snapshot_info()
+                           if outer.statics_store is not None else None)
                 if outer.supervisor is None:
                     body = {"status": "healthy", "actors": {}}
                     if quarantine is not None:
                         body["quarantine"] = quarantine
                     if device is not None:
                         body["device"] = device
+                    if statics is not None:
+                        body["statics"] = statics
                     self._send(200, json.dumps(body).encode(),
                                "application/json")
                     return
@@ -304,6 +324,11 @@ class AgentHTTPServer:
                     # backend != unhealthy agent; the state is surfaced
                     # for operators, not for the readiness verdict.
                     body["device"] = device
+                if statics is not None:
+                    # Statics warmth is an efficiency property, never a
+                    # readiness one: a cold (absent/stale/corrupt)
+                    # snapshot just means the next restart rebuilds.
+                    body["statics"] = statics
                 self._send(503 if status == "dead" else 200,
                            json.dumps(body, indent=1).encode(),
                            "application/json")
@@ -351,6 +376,7 @@ class AgentHTTPServer:
         self.supervisor = supervisor
         self.quarantine = quarantine
         self.device_health = device_health
+        self.statics_store = statics_store
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info

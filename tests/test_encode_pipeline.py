@@ -260,11 +260,14 @@ def test_encoder_dead_row_stats():
 def test_profiler_pipeline_run_matches_classic_and_flushes():
     snap = _snap(seed=9)
     w = Collect()
+    # duration_s bounds the worker's slack before the next close: 0.01
+    # flaked under loaded hosts (window 2 hit backpressure and scalar-
+    # shipped, breaking the windows_pipelined == 2 assertion below).
     p = CPUProfiler(source=ReplaySource([snap, snap]),
                     aggregator=DictAggregator(capacity=1 << 12),
                     fallback_aggregator=CPUAggregator(),
                     profile_writer=w, fast_encode=True,
-                    encode_pipeline=True, duration_s=0.01)
+                    encode_pipeline=True, duration_s=0.1)
     p.run()                       # exhausts the source, flushes, closes
     assert p.crashed is None and p.last_error is None
     assert p._pipeline.stats["windows_pipelined"] == 2

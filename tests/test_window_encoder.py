@@ -407,3 +407,117 @@ def test_encoder_views_are_invalidated_by_the_next_encode():
     stable = bytes(blob)
     enc.encode(c2, snap.time_ns, snap.window_ns, snap.period_ns)
     assert bytes(blob) == stable
+
+
+# -- content-addressed statics ------------------------------------------------
+
+
+def test_rotation_rebuild_is_served_from_the_content_cache():
+    """A registry rotation wipes the per-pid statics map; the content
+    cache (keyed by build inputs, not pids) must serve the rebuild —
+    bytes identical to a fresh cold encoder, with zero re-encoding for
+    the surviving content."""
+    snap1 = generate(_spec(seed=51))
+    snap2 = generate(_spec(seed=52))
+    agg = DictAggregator(capacity=1 << 13, rotate_min_age=1)
+    enc = WindowEncoder(agg)
+    c1 = agg.window_counts(snap1)
+    enc.encode(c1, snap1.time_ns, snap1.window_ns, snap1.period_ns)
+    # Register snap2's stacks and encode once so the POST-growth statics
+    # content is what the cache holds; then rotate snap1's ids out.
+    c2a = agg.window_counts(snap2)
+    enc.encode(c2a, snap2.time_ns, snap2.window_ns, snap2.period_ns)
+    agg._rotate_pending = True
+    c2 = agg.window_counts(snap2)
+    assert agg.stats.get("rotations", 0) == 1
+    built_before = enc.stats["statics_bytes_built"]
+    out = enc.encode(c2, snap2.time_ns, snap2.window_ns, snap2.period_ns)
+    assert enc.stats["statics_cache_hits"] > 0
+    # Surviving pids' sections were not re-encoded, only looked up.
+    assert enc.stats["statics_bytes_reused"] > 0
+    ref = WindowEncoder(agg).encode(c2, snap2.time_ns, snap2.window_ns,
+                                    snap2.period_ns)
+    assert [(p, bytes(b)) for p, b in out] \
+        == [(p, bytes(b)) for p, b in ref]
+    assert enc.stats["statics_bytes_built"] == built_before
+
+
+def test_cross_pid_dedup_shares_identical_statics():
+    """Two pids with byte-identical layouts (same mappings, same stacks
+    — forks, same-image containers) must share ONE head/tail pair and
+    ONE location blob via the content cache."""
+    from parca_agent_tpu.capture.formats import (
+        STACK_SLOTS,
+        MappingTable,
+        WindowSnapshot,
+    )
+
+    table = MappingTable(
+        pids=[1, 2], starts=[0x1000, 0x1000], ends=[0x9000, 0x9000],
+        offsets=[0, 0], objs=[0, 0], obj_paths=("/bin/app",),
+        obj_buildids=("ab" * 20,))
+    stacks = np.zeros((4, STACK_SLOTS), np.uint64)
+    for i, pid in enumerate((1, 1, 2, 2)):
+        stacks[i, :2] = [0x1000 + 0x10 * (i % 2 + 1),
+                         0x1000 + 0x100 * (i % 2 + 1)]
+    snap = WindowSnapshot(
+        pids=[1, 1, 2, 2], tids=[1, 1, 2, 2], counts=[3, 4, 3, 4],
+        user_len=[2] * 4, kernel_len=[0] * 4, stacks=stacks,
+        mappings=table)
+    agg = DictAggregator(capacity=1 << 10)
+    enc = WindowEncoder(agg)
+    c = agg.window_counts(snap)
+    enc.build_statics(snap.period_ns)
+    st1, st2 = enc._static[1], enc._static[2]
+    assert st1.head is st2.head          # one interned blob, two pids
+    assert st1.tail is st2.tail
+    assert st1.loc_bytes is st2.loc_bytes
+    assert enc.stats["statics_bytes_reused"] > 0
+    out = enc.encode(c, snap.time_ns, snap.window_ns, snap.period_ns)
+    _assert_same_profiles(agg, snap, c, out)
+
+
+def test_churn_append_rides_the_vectorized_fast_path():
+    """The churn regime — known stacks reappearing across many pids with
+    unchanged statics — must take the vectorized append (one scatter for
+    all groups), not the per-group walk, and still match the oracle."""
+    snap, agg, enc, c_full = _churn_setup(seed=53, n_pids=12, rows=600)
+    rng = np.random.default_rng(8)
+    c1 = c_full.copy()
+    c1[rng.random(len(c1)) < 0.3] = 0   # hide stacks across every pid
+    enc.encode(c1, snap.time_ns, snap.window_ns, snap.period_ns)
+    enc.timings.clear()
+    out = enc.encode(c_full, snap.time_ns, snap.window_ns, snap.period_ns)
+    assert "encode_build" not in enc.timings      # append, not relayout
+    assert enc.stats["append_fast_groups"] > 0
+    assert enc.stats["append_fast_groups"] >= enc.stats["append_slow_groups"]
+    _assert_same_profiles(agg, snap, c_full, out)
+
+
+def test_adopt_statics_short_circuits_build():
+    """adopt_statics + adopt_registry (the statics store's path) leave
+    nothing to build: statics_backlog is zero and the first encode
+    re-encodes no statics bytes."""
+    snap = generate(_spec(seed=54, n_pids=6, rows=150))
+    agg1 = DictAggregator(capacity=1 << 12)
+    enc1 = WindowEncoder(agg1)
+    c1 = agg1.window_counts(snap)
+    enc1.encode(c1, snap.time_ns, snap.window_ns, snap.period_ns)
+
+    agg2 = DictAggregator(capacity=1 << 12)
+    enc2 = WindowEncoder(agg2)
+    for pid, reg in agg1._pids.items():
+        assert agg2.adopt_registry(
+            pid, list(reg.mappings), list(reg.loc_address),
+            list(reg.loc_normalized), list(reg.loc_mapping_id),
+            list(reg.loc_is_kernel))
+        st = enc1._static[pid]
+        enc2.adopt_statics(pid, st.head, st.tail, bytes(st.loc_bytes),
+                           st.n_mappings, st.n_locs, st.period_ns)
+    assert enc2.statics_backlog(snap.period_ns) == 0
+    c2 = agg2.window_counts(snap)
+    out = enc2.encode(c2, snap.time_ns, snap.window_ns, snap.period_ns)
+    assert enc2.stats["statics_bytes_built"] == 0
+    assert [(p, bytes(b)) for p, b in out] == [
+        (p, bytes(b)) for p, b in enc1.encode(
+            c1, snap.time_ns, snap.window_ns, snap.period_ns)]
