@@ -36,6 +36,7 @@ import time
 from typing import Protocol
 
 from parca_agent_tpu.agent.profilestore import RawSeries
+from parca_agent_tpu.runtime import trace as window_trace
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
@@ -218,6 +219,12 @@ class BatchWriteClient:
             self._replay(budget)
             return True
         attempt = 0
+        # Flight-recorder stages (runtime/trace.py, free when no recorder
+        # is installed): batch_flush is the whole attempt loop — retries,
+        # backoff, and terminal spill included, the end-to-end latency of
+        # getting one batch out of memory — store_ack one successful
+        # WriteRaw round trip.
+        t_flush0 = time.perf_counter()
         # Retries stop at whichever comes first: the per-interval budget
         # (herd control) or the interval deadline (the reference's cap —
         # a flush never runs past its own interval).
@@ -228,9 +235,14 @@ class BatchWriteClient:
                 # rides the same retry/spill machinery as a store failure
                 # (an actor-killing crash is the actor.flush site's job).
                 faults.inject("batch.flush")
+                t_ack0 = time.perf_counter()
                 self._client.write_raw(batch, normalized=True)
+                window_trace.observe("store_ack",
+                                     time.perf_counter() - t_ack0)
                 self.sent_batches += 1
                 self._consec_failures = 0
+                window_trace.observe("batch_flush",
+                                     time.perf_counter() - t_flush0)
                 self._replay(budget)
                 return True
             except Exception as e:
@@ -260,6 +272,8 @@ class BatchWriteClient:
                     _log.warn("batch write failed; will retry next interval",
                               series=len(batch), error=repr(e),
                               consec_failures=self._consec_failures)
+                    window_trace.observe("batch_flush",
+                                         time.perf_counter() - t_flush0)
                     return False
                 budget[0] -= 1
                 self._sleep(delay)
@@ -274,13 +288,17 @@ class BatchWriteClient:
         for _ in range(self._replay_per_interval):
             if budget[0] <= 0 or self._stop.is_set():
                 return
+            t_seg0 = time.perf_counter()
             got = self._spool.read_oldest()
             if got is None:
                 return
             seq, series = got
             budget[0] -= 1
             try:
+                t_ack0 = time.perf_counter()
                 self._client.write_raw(series, normalized=True)
+                window_trace.observe("store_ack",
+                                     time.perf_counter() - t_ack0)
             except Exception as e:
                 # Store flapped again mid-replay: the segment stays for
                 # the next interval (replay is at-least-once; the store
@@ -295,6 +313,9 @@ class BatchWriteClient:
             self.stats["segments_replayed"] += 1
             self.stats["samples_replayed"] += sum(
                 len(s.samples) for s in series)
+            # One replayed segment end-to-end: decode + send + delete.
+            window_trace.observe("spool_replay",
+                                 time.perf_counter() - t_seg0)
 
     def replay_backlog(self) -> tuple[int, int]:
         """(segments, bytes) still spilled on disk (0, 0 without a spool)."""

@@ -10,6 +10,7 @@ generic unary_unary handle, so the dependency stays import-gated.
 from __future__ import annotations
 
 from parca_agent_tpu.agent.profilestore import RawSeries, encode_write_raw_request
+from parca_agent_tpu.runtime import trace as window_trace
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
@@ -250,11 +251,17 @@ class GRPCStoreClient:
             # an injected UNAVAILABLE/handshake drives the same reset
             # bookkeeping a real RPC failure would.
             faults.inject("grpc.write_raw")
+            import time as _time
+
+            t0 = _time.perf_counter()
             method(
                 encode_write_raw_request(series, normalized),
                 timeout=self._timeout,
                 metadata=metadata or None,
             )
+            # The raw RPC alone (store_ack, one layer up in the batch
+            # client, additionally covers serialization + channel build).
+            window_trace.observe("store_rpc", _time.perf_counter() - t0)
         except Exception as e:
             self._note_rpc_failure(e)
             raise

@@ -42,6 +42,7 @@ from parca_agent_tpu.agent.profilestore import (
     decode_write_raw_request,
     encode_write_raw_request,
 )
+from parca_agent_tpu.runtime import trace as window_trace
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 from parca_agent_tpu.utils.vfs import atomic_write_bytes
@@ -132,6 +133,7 @@ class SpoolDir:
         the byte cap. False (with counted drops) when the disk write
         itself fails — the batch is lost, but the agent lives."""
         n_samples = sum(len(s.samples) for s in series)
+        t0 = time.perf_counter()  # spool_spill stage (runtime/trace.py)
         body = bytearray(_MAGIC)
         body += _HEADER.pack(n_samples)
         for s in series:
@@ -155,12 +157,19 @@ class SpoolDir:
                 self.stats["bytes_dropped"] += len(body)
             _log.warn("spool write failed; batch dropped",
                       samples=n_samples, error=repr(e))
+            # Failed spills are observed too: a slow-then-failing disk
+            # is precisely the stall the histogram exists to explain.
+            window_trace.observe("spool_spill", time.perf_counter() - t0)
             return False
         with self._lock:
             self._index[seq] = (len(body), n_samples, self._clock())
             self.stats["segments_written"] += 1
             self.stats["bytes_written"] += len(body)
             self._evict_locked()
+        # One spill end-to-end (encode + frame + atomic write): the
+        # latency a capture-thread overflow pays — exactly what the
+        # flight recorder's spool_spill histogram must answer for.
+        window_trace.observe("spool_spill", time.perf_counter() - t0)
         return True
 
     def _evict_locked(self) -> None:

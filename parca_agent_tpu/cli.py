@@ -72,6 +72,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault-seed", type=int, default=0,
                    help="seed for the fault injector's probability draws "
                         "(PARCA_FAULT_SEED env var)")
+    p.add_argument("--no-window-trace", action="store_true",
+                   help="disable the window flight recorder "
+                        "(docs/observability.md): per-window lifecycle "
+                        "traces on /debug/windows + /debug/trace/<seq>, "
+                        "per-stage latency histograms on /metrics, and "
+                        "the slow-window detector. On by default — the "
+                        "bench's trace_overhead phase holds the tax "
+                        "under 2%% of the close")
+    p.add_argument("--trace-ring", type=int, default=512,
+                   help="completed window traces kept in the flight "
+                        "recorder's ring buffer")
+    p.add_argument("--trace-slow-multiple", type=float, default=5.0,
+                   help="slow-window budget: a stage slower than this "
+                        "multiple of its own running p99 (floored at "
+                        "50 ms, after 8 samples) triggers an incident "
+                        "capture")
+    p.add_argument("--trace-incident-dir", default="",
+                   help="directory for slow-window incident files "
+                        "(crash-only tmp+rename JSON: the offending "
+                        "trace, a self-profile, supervisor/device/"
+                        "quarantine state). Empty disables incident "
+                        "files; slow windows are still counted")
+    p.add_argument("--trace-incident-interval", type=float, default=300.0,
+                   help="minimum seconds between incident captures "
+                        "(rate limit; suppressed captures are counted)")
     p.add_argument("--quarantine-max-strikes", type=int, default=3,
                    help="ingest containment: per-pid input faults "
                         "tolerated per budget window before the pid is "
@@ -664,6 +689,23 @@ def run(argv=None) -> int:
                 quarantine=quarantine)
             source.on_drain = feeder.on_drain
 
+    # -- window flight recorder (docs/observability.md) ----------------------
+    # Always-on unless opted out: per-window lifecycle traces, per-stage
+    # histograms, slow-window auto-capture. Installed process-globally so
+    # the transport/encoder components observe their stages without
+    # plumbing; the incident context (supervisor/device/quarantine) is
+    # late-bound below once those exist.
+    recorder = None
+    if not args.no_window_trace:
+        from parca_agent_tpu.runtime import trace as trace_mod
+
+        recorder = trace_mod.FlightRecorder(
+            ring=args.trace_ring,
+            slow_multiple=args.trace_slow_multiple,
+            incident_dir=args.trace_incident_dir,
+            incident_interval_s=args.trace_incident_interval)
+        trace_mod.install(recorder)
+
     # -- warm statics snapshot (docs/perf.md "the statics wall") -------------
     statics_store = None
     if args.statics_snapshot_path:
@@ -701,6 +743,7 @@ def run(argv=None) -> int:
         statics_store=statics_store,
         statics_snapshot_every=args.statics_snapshot_interval,
         statics_cache_bytes=args.statics_cache_bytes,
+        trace_recorder=recorder,
     )
 
     if statics_store is not None and profiler._encoder is not None:
@@ -723,6 +766,23 @@ def run(argv=None) -> int:
 
     sup = Supervisor()
 
+    if recorder is not None:
+        # Incident context: whatever runtime state exists when a slow
+        # window fires — supervisor actor states, device-health machine,
+        # quarantine population — captured at dump time, not now.
+        def _trace_context() -> dict:
+            ctx: dict = {"supervisor": sup.health(),
+                         "overall": sup.overall()}
+            if device_health is not None:
+                ctx["device"] = device_health.snapshot()
+            if quarantine is not None:
+                ctx["quarantine"] = quarantine.snapshot()
+            if statics_store is not None:
+                ctx["statics"] = statics_store.snapshot_info()
+            return ctx
+
+        recorder.set_context(_trace_context)
+
     # -- HTTP ----------------------------------------------------------------
     def capture_metrics():
         """Capture-loss observability (VERDICT r1 weak #5): ring LOST
@@ -742,7 +802,9 @@ def run(argv=None) -> int:
             out["parca_agent_capture_dedup_hits_total"] = source.dedup_hits
             out["parca_agent_capture_dedup_overflow_total"] = \
                 source.dedup_overflow
-        labels = ",".join(f'{k}="{v}"'
+        from parca_agent_tpu.web import escape_label_value
+
+        labels = ",".join(f'{k}="{escape_label_value(v)}"'
                           for k, v in binfo.as_metrics().items())
         out[f"parca_agent_build_info{{{labels}}}"] = 1
         if hasattr(store, "stats"):
@@ -804,7 +866,8 @@ def run(argv=None) -> int:
                            capture_info=capture_metrics,
                            supervisor=sup, quarantine=quarantine,
                            device_health=device_health,
-                           statics_store=statics_store)
+                           statics_store=statics_store,
+                           recorder=recorder)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None

@@ -98,6 +98,7 @@ from parca_agent_tpu.pprof.vec import (
     ragged_gather,
     varint_len,
 )
+from parca_agent_tpu.runtime import trace as window_trace
 
 _TAG_SAMPLE = 0x12       # field 2 (Profile.sample), wire 2
 _TAG_S_LOCID = 0x0A      # field 1 (Sample.location_id), wire 2 (packed)
@@ -447,6 +448,13 @@ class WindowEncoder:
             "statics_adopted_pids": 0,
             "append_fast_groups": 0,
             "append_slow_groups": 0,
+            # Statics build clock: per-call duration (the gauge) and the
+            # monotone accumulator the pipeline worker diffs to span the
+            # statics work that ran INSIDE one window's encode. The same
+            # per-call number feeds the "statics" stage histogram
+            # (runtime/trace.py), so gauge and histogram cannot disagree.
+            "last_statics_build_s": 0.0,
+            "statics_build_s_total": 0.0,
         }
 
     # -- content cache -------------------------------------------------------
@@ -991,6 +999,11 @@ class WindowEncoder:
             # version was read BEFORE the scan, so a concurrent insert
             # landing mid-walk re-arms the scan on the next call.
             self._statics_clean = version
+        if did_work:
+            dt = _time.perf_counter() - t0
+            self.stats["last_statics_build_s"] = dt
+            self.stats["statics_build_s_total"] += dt
+            window_trace.observe("statics", dt)
         return len(targets) - len(left)
 
     def statics_backlog(self, period_ns: int) -> int:

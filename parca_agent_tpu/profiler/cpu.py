@@ -24,6 +24,7 @@ from parca_agent_tpu.aggregator.base import Aggregator, PidProfile
 from parca_agent_tpu.capture.formats import WindowSnapshot
 from parca_agent_tpu.pprof.builder import build_pprof
 from parca_agent_tpu.runtime.quarantine import apply_ladder
+from parca_agent_tpu.runtime.trace import NULL_TRACE
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
@@ -88,9 +89,17 @@ class CPUProfiler:
         statics_store=None,
         statics_snapshot_every: int = 6,
         statics_cache_bytes: int = 256 << 20,
+        trace_recorder=None,
     ):
         self._source = source
         self._aggregator = aggregator
+        # Window flight recorder (runtime/trace.py): one trace per
+        # window, spans recorded here, in the encode pipeline's worker,
+        # and in the encoder. Tracing is fail-open by contract — every
+        # recorder entry point swallows its own errors — so nothing in
+        # this file guards a tracing call with anything heavier than the
+        # NULL_TRACE default.
+        self._recorder = trace_recorder
         # Ingest containment (runtime/quarantine.py): the profiler owns
         # the window clock, so it ticks the registry once per iteration
         # and routes aggregated profiles down the degradation ladder
@@ -399,8 +408,11 @@ class CPUProfiler:
 
     def run_iteration(self) -> bool:
         """Returns False when the source is exhausted."""
+        tr = (self._recorder.begin() if self._recorder is not None
+              else NULL_TRACE)
         try:
-            snapshot = self._source.poll()
+            with tr.span("drain"):
+                snapshot = self._source.poll()
         except Exception as e:
             # Capture trouble is non-fatal, like any other iteration error
             # (cpu.go:326-330): a transient drain failure must not kill the
@@ -410,17 +422,26 @@ class CPUProfiler:
             self.metrics.errors_total += 1
             _log.warn("capture poll failed; retrying next window",
                       error=repr(e))
+            tr.finish(error=repr(e)[:200])
             return True
         if snapshot is None:
+            tr.discard()  # never a window: not ringed, not histogrammed
             return False
         self.last_profile_started_at = time.time()
         self.metrics.attempts_total += 1
+        tr.annotate(time_ns=snapshot.time_ns,
+                    samples=int(snapshot.total_samples()))
         t_start = time.perf_counter()
         try:
             if self._encoder is not None:
-                n_pids = self._aggregate_encode_write(snapshot)
+                n_pids = self._aggregate_encode_write(snapshot, tr)
             else:
-                profiles = self.obtain_profiles(snapshot)
+                # Scalar path spans: close (aggregate), symbolize, ship.
+                # The close gauge is set FROM the span duration so the
+                # last-value gauge and the histogram can never disagree.
+                with tr.span("close") as sp_close:
+                    profiles = self.obtain_profiles(snapshot)
+                self.metrics.last_aggregate_duration_s = sp_close.duration_s
                 self.metrics.samples_aggregated += snapshot.total_samples()
 
                 # Degradation ladder first (level-1 pids lose local
@@ -430,14 +451,16 @@ class CPUProfiler:
                 profiles = apply_ladder(profiles, self._quarantine)
 
                 if self._symbolizer is not None:
-                    t0 = time.perf_counter()
-                    self._symbolizer.symbolize(profiles)
+                    with tr.span("symbolize") as sp_sym:
+                        self._symbolizer.symbolize(profiles)
                     self.metrics.last_symbolize_duration_s = \
-                        time.perf_counter() - t0
+                        sp_sym.duration_s
 
-                for prof in profiles:
-                    self._write_profile(prof)
+                with tr.span("ship"):
+                    for prof in profiles:
+                        self._write_profile(prof)
                 n_pids = len(profiles)
+                tr.annotate(pids=n_pids, path="scalar")
 
             if self._debuginfo is not None:
                 objs = []
@@ -462,6 +485,10 @@ class CPUProfiler:
             self.last_error = e
             self.metrics.errors_total += 1
             _log.warn("profile iteration failed", error=repr(e))
+            tr.finish(error=repr(e)[:200])
+        # Pipelined windows detached their trace (the encode worker
+        # completes it after the ship); everything else finishes here.
+        tr.finish()
         if self._quarantine is not None:
             # Quarantine time is window time: cooldown/probation advance
             # once per iteration, whether or not the window shipped.
@@ -587,7 +614,8 @@ class CPUProfiler:
             self._write_profile(prof)
         return len(profiles)
 
-    def _aggregate_encode_write(self, snapshot: WindowSnapshot) -> int:
+    def _aggregate_encode_write(self, snapshot: WindowSnapshot,
+                                tr=NULL_TRACE) -> int:
         """Fast path: counts -> vectorized encoder -> writer, no PidProfile
         materialization. ONLY the device call rides the hang watchdog (on
         failure/hang the CPU fallback aggregates and writes through the
@@ -596,7 +624,6 @@ class CPUProfiler:
         rebuild is tens of seconds at 50k pids) must not eat the device
         watchdog's budget and read as a wedged device. An encoder FAILURE
         still falls back to the scalar path for that window."""
-        t0 = time.perf_counter()
         self._windows_seen += 1  # hang-cooldown clock (obtain_profiles' twin)
 
         def fast():
@@ -617,38 +644,66 @@ class CPUProfiler:
         def fallback():
             return "prof", self._fallback.aggregate(snapshot)
 
-        kind, out = self._guarded(fast, fallback)
+        # The close span is the guarded device call (streaming: the
+        # packed close fetch rides inside take_window_if_complete); its
+        # duration also sets the aggregate gauge, so gauge and histogram
+        # are the same measurement.
+        with tr.span("close") as sp_close:
+            kind, out = self._guarded(fast, fallback)
+        self.metrics.last_aggregate_duration_s = sp_close.duration_s
+        if self._feeder is not None and kind == "counts":
+            # Streamed windows: the mid-window feed work and the packed
+            # close fetch are tracked by the feeder — record them as
+            # spans from the SAME numbers its stats export (lockstep).
+            fed = self._feeder.stats.get("last_window_feed_s", 0.0)
+            if fed:
+                tr.add_span("feed", fed)
+            if self._feeder.stats.get("last_window_streamed", 0):
+                tr.add_span("fetch",
+                            self._feeder.stats.get("last_close_s", 0.0))
         if kind == "counts":
-            n_piped = self._submit_to_pipeline(out, snapshot)
+            n_piped = self._submit_to_pipeline(out, snapshot, tr)
             if n_piped is not None:
-                self.metrics.last_aggregate_duration_s = \
-                    time.perf_counter() - t0
                 self.metrics.samples_aggregated += snapshot.total_samples()
                 return n_piped
             try:
                 out = self._encode_inline(out, snapshot)
                 kind = "enc"
+                tr.add_span("encode", self.metrics.last_encode_duration_s)
             except Exception as e:  # noqa: BLE001 - window must still ship
+                if getattr(self, "_encode_timed", False):
+                    # Only span an encode that actually ran: the
+                    # inflight-guard raise happens before any timing and
+                    # must not fabricate a sample from the previous
+                    # window's gauge value.
+                    tr.add_span("encode",
+                                self.metrics.last_encode_duration_s,
+                                error=repr(e)[:200])
                 if self._fallback is None:
                     raise
                 _log.warn("fast encode failed; scalar fallback for this "
                           "window", error=repr(e))
                 kind, out = fallback()
-        self.metrics.last_aggregate_duration_s = time.perf_counter() - t0
         self.metrics.samples_aggregated += snapshot.total_samples()
         if kind == "prof":
-            for prof in out:
-                self._write_profile(prof)
+            tr.annotate(path="scalar-fallback")
+            with tr.span("ship"):
+                for prof in out:
+                    self._write_profile(prof)
             return len(out)
-        return self._write_encoded(out)
+        tr.annotate(path="inline")
+        with tr.span("ship"):
+            return self._write_encoded(out)
 
-    def _submit_to_pipeline(self, counts, snapshot: WindowSnapshot
-                            ) -> int | None:
+    def _submit_to_pipeline(self, counts, snapshot: WindowSnapshot,
+                            tr=NULL_TRACE) -> int | None:
         """Try to hand the closed window to the encode pipeline. Returns
         the handed-off pid count, the scalar-fallback profile count when
         backpressure forced an inline ship, or None when the window must
         take the inline encode path (no pipeline / pipeline disabled /
-        backpressure without a fallback aggregator)."""
+        backpressure without a fallback aggregator). On a successful
+        hand-off the window's trace detaches: the worker records the
+        encode/ship spans and completes it after the ship."""
         if self._pipeline is None or self._pipeline.disabled:
             return None
         fb = None
@@ -657,7 +712,8 @@ class CPUProfiler:
         try:
             n = self._pipeline.submit(counts, snapshot.time_ns,
                                       snapshot.window_ns,
-                                      snapshot.period_ns, fallback=fb)
+                                      snapshot.period_ns, fallback=fb,
+                                      trace=tr)
         except Exception as e:  # noqa: BLE001 - window must still ship
             # prepare() died on the profiler thread (e.g. MemoryError
             # growing mirrors): give this window to the inline path,
@@ -666,6 +722,7 @@ class CPUProfiler:
                       "window", error=repr(e))
             return None
         if n is not None:
+            tr.annotate(path="pipeline")
             return n
         # Backpressure: the worker is still encoding the previous window.
         # The encoder's state is its — this window cannot ride it inline,
@@ -677,15 +734,18 @@ class CPUProfiler:
             self._pipeline.flush(timeout_s=self._encode_deadline or 60.0)
             n = self._pipeline.submit(counts, snapshot.time_ns,
                                       snapshot.window_ns,
-                                      snapshot.period_ns)
+                                      snapshot.period_ns, trace=tr)
             if n is None:
                 raise RuntimeError(
                     "encode pipeline busy past its flush bound and no "
                     "fallback aggregator is configured")
+            tr.annotate(path="pipeline")
             return n
         _log.warn("encode pipeline busy at window close; scalar fallback "
                   "for this window")
-        return self._ship_scalar(snapshot)
+        tr.annotate(path="scalar-backpressure")
+        with tr.span("ship"):
+            return self._ship_scalar(snapshot)
 
     def _encode_inline(self, counts, snapshot: WindowSnapshot):
         """Encode on the profiler thread (no pipeline, or pipeline
@@ -695,6 +755,11 @@ class CPUProfiler:
         costs this window a scalar fallback instead of an unbounded
         capture stall — and the abandoned encode keeps warming the
         template for the windows after it."""
+        # False until this WINDOW's encode is actually timed: the
+        # inflight-guard raise below exits before any timing, and the
+        # trace must not record the previous window's duration as this
+        # window's errored encode span.
+        self._encode_timed = False
         if self._encode_inflight is not None:
             if not self._encode_inflight.is_set():
                 # The abandoned encode still owns the encoder's state.
@@ -710,6 +775,7 @@ class CPUProfiler:
             self._encode_inflight = None
             self._encode_abandoned = None
         t0 = time.perf_counter()
+        self._encode_timed = True
         try:
             if self._encode_deadline is None:
                 return self._encoder.encode(

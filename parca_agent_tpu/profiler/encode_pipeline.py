@@ -39,6 +39,7 @@ from __future__ import annotations
 import threading
 import time
 
+from parca_agent_tpu.runtime.trace import NULL_TRACE
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
@@ -100,13 +101,16 @@ class EncodePipeline:
     # -- profiler-thread API -------------------------------------------------
 
     def submit(self, counts, time_ns: int, duration_ns: int, period_ns: int,
-               fallback=None) -> int | None:
+               fallback=None, trace=NULL_TRACE) -> int | None:
         """Hand one closed window to the worker. Returns the number of
         live pids handed off, or None when the pipeline is disabled or
         still busy with the previous window (backpressure — the caller
         must ship the window itself, normally via its scalar fallback).
         `fallback`, a zero-arg callable, re-aggregates and ships the
-        window if the worker dies on it. Profiler thread only."""
+        window if the worker dies on it. `trace`, the window's
+        WindowTrace, detaches on a successful hand-off: the worker
+        records the encode/ship spans and completes it after the ship.
+        Profiler thread only."""
         if self.disabled or self._stopping:
             return None
         t0 = time.perf_counter()
@@ -121,20 +125,22 @@ class EncodePipeline:
             while self._state != "idle":
                 self._cond.wait()
         try:
-            prep = self._enc.prepare(counts, time_ns, duration_ns,
-                                     period_ns)
+            with trace.span("prepare"):
+                prep = self._enc.prepare(counts, time_ns, duration_ns,
+                                         period_ns)
         except BaseException:
             with self._cond:
                 self._handoff = False
                 self._interrupt.clear()
                 self._cond.notify_all()
             raise
+        trace.detach()
         with self._cond:
             # Enqueue and unpark in ONE lock acquisition: clearing
             # _handoff first would let a pending prebuild slip in ahead
             # of the window (with _interrupt already cleared, nothing
             # would yield it) and delay the encode by a whole budget.
-            self._window = (prep, fallback)
+            self._window = (prep, fallback, trace)
             self._handoff = False
             self._interrupt.clear()
             self._cond.notify_all()
@@ -232,7 +238,7 @@ class EncodePipeline:
                     self.stats["prebuilds"] += 1
             except Exception as e:  # noqa: BLE001 - surfaced via disable
                 if job[0] == "window":
-                    self._fail_window(e, job[1][1])
+                    self._fail_window(e, job[1][1], job[1][2])
                     with self._cond:
                         self._state = "idle"
                         self._cond.notify_all()
@@ -263,16 +269,31 @@ class EncodePipeline:
         self.last_error = None
         _log.info("encode pipeline revived")
 
-    def _do_window(self, prep, fallback) -> None:
+    def _do_window(self, prep, fallback, trace=NULL_TRACE) -> None:
         t0 = time.perf_counter()
         # Chaos site: an injected crash here is a worker death — the
         # window ships via the caller's fallback, the pipeline disables,
         # and the supervisor's probe revives it.
         faults.inject("actor.encode")
+        # Statics work that runs inside this encode (a cold build, a
+        # post-rotation rebuild) is the latency cliff the trace exists
+        # for: span it from the encoder's own accumulated-build clock so
+        # the span and the encoder's stats can never disagree.
+        statics0 = getattr(self._enc, "stats", {}).get(
+            "statics_build_s_total", 0.0)
         out = self._enc.encode_prepared(prep, views=self._views)
         enc_s = time.perf_counter() - t0
         self.stats["last_encode_s"] = enc_s
         self.stats["overlap_s_total"] += enc_s
+        statics_s = getattr(self._enc, "stats", {}).get(
+            "statics_build_s_total", 0.0) - statics0
+        if statics_s > 0:
+            # histogram=False: the encoder already observed each build
+            # call into the "statics" stage histogram; this span is the
+            # per-window wide-event view only (double-feeding the same
+            # seconds would distort the distribution).
+            trace.add_span("statics", statics_s, histogram=False)
+        trace.add_span("encode", enc_s)
         t0 = time.perf_counter()
         try:
             self._ship(out, prep)
@@ -286,9 +307,15 @@ class EncodePipeline:
             self.stats["ship_errors"] += 1
             _log.warn("pipelined ship failed; window partially shipped",
                       error=repr(e))
+            trace.add_span("ship", time.perf_counter() - t0,
+                           error=repr(e)[:200])
+            trace.complete(error=f"ship failed: {e!r}"[:200])
             return
-        self.stats["last_ship_s"] = time.perf_counter() - t0
+        ship_s = time.perf_counter() - t0
+        self.stats["last_ship_s"] = ship_s
+        trace.add_span("ship", ship_s)
         self.stats["windows_pipelined"] += 1
+        trace.complete()
         if self._snapshot is not None and self._snapshot_every > 0 \
                 and self.stats["windows_pipelined"] \
                 % self._snapshot_every == 0:
@@ -315,11 +342,14 @@ class EncodePipeline:
                           error=repr(e))
             self.stats["last_snapshot_s"] = time.perf_counter() - t0
 
-    def _fail_window(self, e: Exception, fallback) -> None:
+    def _fail_window(self, e: Exception, fallback,
+                     trace=NULL_TRACE) -> None:
         """Worker died on a window: disable the pipeline (the profiler
         reverts to its inline path), reset the encoder's possibly
         half-mutated state, and ship the window via the caller's scalar
-        fallback so it is not lost."""
+        fallback so it is not lost. The window's trace completes with
+        the error either way — a lost window must be visible in the
+        flight recorder, not just in a counter."""
         self.stats["encoder_exceptions"] += 1
         self.last_error = e
         self.disabled = True
@@ -330,13 +360,20 @@ class EncodePipeline:
         except Exception as e2:  # noqa: BLE001 - reset is best-effort
             _log.warn("encoder reset failed after pipeline error",
                       error=repr(e2))
-        if fallback is None:
-            self.stats["windows_lost"] += 1
-            _log.warn("no fallback for the failed window; window lost")
-            return
         try:
-            fallback()
-        except Exception as e2:  # noqa: BLE001 - like an iteration error
-            self.stats["windows_lost"] += 1
-            _log.warn("scalar fallback for the failed window also failed",
-                      error=repr(e2))
+            if fallback is None:
+                self.stats["windows_lost"] += 1
+                _log.warn("no fallback for the failed window; window lost")
+                trace.annotate(window_lost=True)
+                return
+            try:
+                with trace.span("ship"):
+                    fallback()
+                trace.annotate(path="scalar-pipeline-fail")
+            except Exception as e2:  # noqa: BLE001 - like an iteration error
+                self.stats["windows_lost"] += 1
+                trace.annotate(window_lost=True)
+                _log.warn("scalar fallback for the failed window also "
+                          "failed", error=repr(e2))
+        finally:
+            trace.complete(error=repr(e)[:200])

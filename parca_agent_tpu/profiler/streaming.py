@@ -121,7 +121,16 @@ class StreamingWindowFeeder:
         self.external_blocked = None
         self.stats = {"drains_fed": 0, "windows_streamed": 0,
                       "windows_fallback": 0, "reprobes": 0,
-                      "statics_prebuilt": 0, "last_close_s": 0.0}
+                      "statics_prebuilt": 0, "last_close_s": 0.0,
+                      # Flight-recorder feed/fetch spans (runtime/
+                      # trace.py): capture-thread seconds spent in this
+                      # window's drain tees, and whether the LAST window
+                      # actually streamed (gates the fetch span — a
+                      # fallback window must not re-record a stale
+                      # last_close_s).
+                      "last_window_feed_s": 0.0,
+                      "last_window_streamed": 0}
+        self._window_feed_s = 0.0
 
     def attach_encoder(self, encoder, prebuild=None) -> None:
         """Wire the profiler's WindowEncoder for statics amortization.
@@ -174,55 +183,65 @@ class StreamingWindowFeeder:
         pids, tids, ulen, klen, stacks, counts = cols
         if not len(pids):
             return
+        t_feed0 = time.perf_counter()
         try:
-            table = mapping_table_for_pids(self._maps, self._objs,
-                                           np.unique(pids).tolist(),
-                                           quarantine=self._quarantine)
-        except Exception as e:  # noqa: BLE001 - a poisoned maps file
-            # (PoisonInput surfaces here only without a registry) must
-            # cost this DRAIN, not the capture loop: skip the feed; the
-            # fed-mass mismatch makes the window one-shot, exactly right.
-            _log.warn("drain mapping build failed; skipping feed",
-                      error=repr(e))
-            return
-        mini = columns_to_snapshot(pids, tids, ulen, klen, stacks,
-                                   table, 0, 0, weights=counts)
-        if len(mini) == 0:
-            return
-        if self._fed_total == 0 and (getattr(self._agg, "_fed_total", 0)
-                                     or getattr(self._agg, "_pending", None)):
-            # First feed of a new window with residual open-window state:
-            # a one-shot failed partway (its feed dispatched mass and/or
-            # registered host-side pending rows, its close never ran).
-            # Discard it all — device acc via the reset flag, host mirrors
-            # directly — exactly as window_counts guards its own entry
-            # (aggregator/dict.py). Without this the residue would ride
-            # into the streamed close and inflate counts past the
-            # feeder's own fed-mass gate ("_pending" survives an acc
-            # reset: the flag only zeroes the device accumulator).
-            self._agg._fed_total = 0
-            self._agg._pending = []
-            self._agg._needs_reset = True
-        if not self._feed_guarded(mini):
-            # Do NOT try again this window: a wedged device would stall
-            # the capture loop on every subsequent drain. Re-probe only
-            # at a window boundary, after a capped-exponential cooldown.
-            self._enter_cooldown("streaming feed failed")
-            return
-        self._fed_total += mini.total_samples()
-        self.stats["drains_fed"] += 1
-        if self._encoder is not None and self._prebuild_period:
             try:
-                if self._prebuild_fn is not None:
-                    self._prebuild_fn(self._prebuild_period,
-                                      self._prebuild_budget)
-                else:
-                    self._encoder.build_statics(
-                        self._prebuild_period,
-                        budget_s=self._prebuild_budget)
-                self.stats["statics_prebuilt"] += 1
-            except Exception as e:  # noqa: BLE001 - never fail the tee
-                _log.warn("statics prebuild failed", error=repr(e))
+                table = mapping_table_for_pids(
+                    self._maps, self._objs, np.unique(pids).tolist(),
+                    quarantine=self._quarantine)
+            except Exception as e:  # noqa: BLE001 - a poisoned maps file
+                # (PoisonInput surfaces here only without a registry) must
+                # cost this DRAIN, not the capture loop: skip the feed; the
+                # fed-mass mismatch makes the window one-shot, exactly
+                # right.
+                _log.warn("drain mapping build failed; skipping feed",
+                          error=repr(e))
+                return
+            mini = columns_to_snapshot(pids, tids, ulen, klen, stacks,
+                                       table, 0, 0, weights=counts)
+            if len(mini) == 0:
+                return
+            if self._fed_total == 0 \
+                    and (getattr(self._agg, "_fed_total", 0)
+                         or getattr(self._agg, "_pending", None)):
+                # First feed of a new window with residual open-window
+                # state: a one-shot failed partway (its feed dispatched
+                # mass and/or registered host-side pending rows, its close
+                # never ran). Discard it all — device acc via the reset
+                # flag, host mirrors directly — exactly as window_counts
+                # guards its own entry (aggregator/dict.py). Without this
+                # the residue would ride into the streamed close and
+                # inflate counts past the feeder's own fed-mass gate
+                # ("_pending" survives an acc reset: the flag only zeroes
+                # the device accumulator).
+                self._agg._fed_total = 0
+                self._agg._pending = []
+                self._agg._needs_reset = True
+            if not self._feed_guarded(mini):
+                # Do NOT try again this window: a wedged device would
+                # stall the capture loop on every subsequent drain.
+                # Re-probe only at a window boundary, after a
+                # capped-exponential cooldown.
+                self._enter_cooldown("streaming feed failed")
+                return
+            self._fed_total += mini.total_samples()
+            self.stats["drains_fed"] += 1
+            if self._encoder is not None and self._prebuild_period:
+                try:
+                    if self._prebuild_fn is not None:
+                        self._prebuild_fn(self._prebuild_period,
+                                          self._prebuild_budget)
+                    else:
+                        self._encoder.build_statics(
+                            self._prebuild_period,
+                            budget_s=self._prebuild_budget)
+                    self.stats["statics_prebuilt"] += 1
+                except Exception as e:  # noqa: BLE001 - never fail the tee
+                    _log.warn("statics prebuild failed", error=repr(e))
+        finally:
+            # Capture-thread seconds this window spent feeding (the
+            # flight recorder's feed span reads the per-window total).
+            self._window_feed_s += time.perf_counter() - t_feed0
 
     def _feed_guarded(self, mini: WindowSnapshot) -> bool:
         box: dict = {}
@@ -262,6 +281,9 @@ class StreamingWindowFeeder:
         for the next window."""
         fed = self._fed_total
         self._fed_total = 0
+        self.stats["last_window_feed_s"] = self._window_feed_s
+        self._window_feed_s = 0.0
+        self.stats["last_window_streamed"] = 0
         if snapshot.period_ns:
             self._prebuild_period = snapshot.period_ns
         if self.disabled:
@@ -291,6 +313,7 @@ class StreamingWindowFeeder:
         t0 = time.perf_counter()
         counts = self._agg.close_window(copy=False)
         self.stats["windows_streamed"] += 1
+        self.stats["last_window_streamed"] = 1
         self.stats["last_close_s"] = time.perf_counter() - t0
         self._backoff = self._backoff_base  # healthy again: reset backoff
         return counts

@@ -1,0 +1,188 @@
+"""`make trace-smoke`: the window flight recorder's end-to-end drill.
+
+Runs a short traced session through the real profiler loop (synthetic
+capture, dict aggregator, fast encode, encode pipeline, HTTP surface)
+and asserts the observability contract (docs/observability.md):
+
+  1. `/debug/windows` returns >= 3 COMPLETE traces, each carrying every
+     mandatory span (drain, close, prepare, encode, ship).
+  2. `/metrics` parses and serves the stage-duration histogram for >= 6
+     stages.
+  3. One injected slow window (a `device.dispatch` hang well past the
+     primed p99 budget) produces EXACTLY ONE incident file containing
+     the offending trace and a self-profile — and zero windows are
+     lost.
+
+Exit 0 on success; raises (exit 1) with a readable assertion otherwise.
+Host-side only: the Make target pins JAX_PLATFORMS=cpu.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+
+def main() -> int:
+    # Like tests/conftest.py: the ambient sitecustomize may have forced
+    # a device platform; the smoke is host-side by design.
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from parca_agent_tpu.aggregator.cpu import CPUAggregator
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+    from parca_agent_tpu.profiler.cpu import CPUProfiler
+    from parca_agent_tpu.runtime.trace import (
+        MANDATORY_SPANS,
+        FlightRecorder,
+    )
+    from parca_agent_tpu.runtime import trace as trace_mod
+    from parca_agent_tpu.utils import faults
+    from parca_agent_tpu.web import AgentHTTPServer
+
+    n_prime = int(os.environ.get("PARCA_TRACE_SMOKE_WINDOWS", "8"))
+    tmp = tempfile.mkdtemp(prefix="parca-trace-smoke-")
+    incident_dir = os.path.join(tmp, "incidents")
+
+    snaps = [generate(SyntheticSpec(
+        n_pids=6, n_unique_stacks=256, n_rows=256, total_samples=1024,
+        mean_depth=8, seed=i)) for i in range(n_prime + 1)]
+
+    class Src:
+        def __init__(self):
+            self.snaps = list(snaps)
+
+        def poll(self):
+            return self.snaps.pop(0) if self.snaps else None
+
+    shipped = []
+
+    class Sink:
+        def write(self, labels, blob):
+            shipped.append(len(blob))
+
+    # Pre-warm the aggregation programs OUTSIDE the traced session: the
+    # first window's XLA compile (seconds) would otherwise dominate the
+    # close histogram's p99 and hide the injected stall behind an
+    # inflated budget — a production agent is past compile within its
+    # first window too.
+    agg = DictAggregator(capacity=1 << 12)
+    agg.window_counts(generate(SyntheticSpec(
+        n_pids=6, n_unique_stacks=256, n_rows=256, total_samples=1024,
+        mean_depth=8, seed=99)))
+
+    recorder = FlightRecorder(
+        ring=64, min_count=4, min_duration_s=0.05, slow_multiple=5.0,
+        incident_dir=incident_dir,
+        # A fast self-profile keeps the smoke quick; the incident still
+        # carries a REAL gzipped pprof of the agent's threads.
+        self_profile=None, self_profile_s=0.3,
+        context=lambda: {"smoke": True})
+    trace_mod.install(recorder)
+
+    prof = CPUProfiler(
+        source=Src(), aggregator=agg,
+        fallback_aggregator=CPUAggregator(), profile_writer=Sink(),
+        duration_s=0.0, fast_encode=True, encode_pipeline=True,
+        trace_recorder=recorder)
+
+    http = AgentHTTPServer(port=0, profilers=[prof], recorder=recorder)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+
+    def fetch(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.read().decode()
+
+    try:
+        # -- prime: n_prime clean windows ------------------------------------
+        for _ in range(n_prime):
+            assert prof.run_iteration()
+        assert prof._pipeline.flush(30)
+
+        body = json.loads(fetch("/debug/windows"))
+        complete = [t for t in body["traces"]
+                    if t["complete"] and "error" not in t]
+        assert len(complete) >= 3, f"only {len(complete)} complete traces"
+        for t in complete:
+            stages = {s["stage"] for s in t["spans"]}
+            missing = set(MANDATORY_SPANS) - stages
+            assert not missing, f"trace {t['seq']} missing spans {missing}"
+        print(f"trace-smoke: {len(complete)} complete traces, "
+              f"all mandatory spans present")
+
+        metrics = fetch("/metrics")
+        stages_in_metrics = {
+            line.split('stage="', 1)[1].split('"', 1)[0]
+            for line in metrics.splitlines()
+            if line.startswith(
+                "parca_agent_window_stage_duration_seconds_bucket")}
+        assert len(stages_in_metrics) >= 6, \
+            f"only {len(stages_in_metrics)} stages in /metrics: " \
+            f"{sorted(stages_in_metrics)}"
+        assert "# TYPE parca_agent_window_stage_duration_seconds " \
+            "histogram" in metrics
+        print(f"trace-smoke: /metrics histograms for "
+              f"{len(stages_in_metrics)} stages: "
+              f"{sorted(stages_in_metrics)}")
+
+        # -- injected slow window --------------------------------------------
+        # A 400 ms device.dispatch hang: ~2 orders of magnitude over the
+        # primed close p99, well under the 60 s watchdog — the window
+        # still ships, the detector fires, exactly one incident lands.
+        faults.install(faults.FaultInjector.from_spec(
+            "device.dispatch:hang:ms=400,count=1"))
+        try:
+            assert prof.run_iteration()
+            assert prof._pipeline.flush(30)
+        finally:
+            faults.install(None)
+
+        deadline = time.monotonic() + 15
+        files = []
+        while time.monotonic() < deadline:
+            files = (sorted(os.listdir(incident_dir))
+                     if os.path.isdir(incident_dir) else [])
+            if files and not recorder._dumping:
+                break
+            time.sleep(0.05)
+        assert len(files) == 1, f"expected exactly 1 incident, got {files}"
+        incident = json.loads(
+            open(os.path.join(incident_dir, files[0])).read())
+        assert incident["kind"] == "slow_window"
+        assert incident["trace"] is not None
+        assert incident["trace"]["seq"] == n_prime + 1
+        assert incident["self_profile_pprof_gz_b64"], "no self-profile"
+        assert incident["context"] == {"smoke": True}
+        slow_stages = [s["stage"] for s in incident["trace"]["spans"]
+                       if s.get("slow")]
+        assert slow_stages, "no span marked slow in the incident trace"
+
+        # -- nothing lost ----------------------------------------------------
+        assert prof.crashed is None and prof.last_error is None
+        assert prof._pipeline.stats["windows_lost"] == 0
+        assert prof.metrics.attempts_total == n_prime + 1
+        done = recorder.stats["traces_completed"]
+        assert done == n_prime + 1, \
+            f"{done} of {n_prime + 1} traces completed"
+        one = json.loads(fetch(f"/debug/trace/{n_prime + 1}"))
+        assert one["meta"].get("slow_stage") in ("close", "total")
+        print(f"trace-smoke: slow window produced exactly 1 incident "
+              f"({files[0]}), slow stage "
+              f"{one['meta']['slow_stage']!r}, windows_lost=0")
+        print("trace-smoke: PASS")
+        return 0
+    finally:
+        http.stop()
+        trace_mod.install(None)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,15 @@ layer the ship-path components consult at NAMED SITES:
                       (pprof/statics_store.py; disk_full/error — a
                       failed snapshot is counted and skipped, the
                       window it followed is already shipped)
+    trace.record      every flight-recorder entry point (runtime/
+                      trace.py begin/add_span/complete/observe) — the
+                      tracing path is FAIL-OPEN by contract: an injected
+                      fault here is swallowed and counted
+                      (record_errors) and must never stall or lose a
+                      window (docs/observability.md)
+    incident.dump     the slow-window incident writer — an injected
+                      fault costs the incident file (incidents_failed),
+                      never the window
 
 and, on the ingest side (docs/robustness.md "ingest containment" — the
 ``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an

@@ -56,19 +56,96 @@ def render_status_page(profilers, version: str = "dev",
     )
 
 
+def escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the exposition is unparseable
+    (a binary path or an error string in a label used to corrupt the
+    whole scrape)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, float):
+        return format(v, ".10g")
+    return str(v)
+
+
+class _MetricsBuffer:
+    """Collects samples grouped by metric family so the rendered text is
+    strict Prometheus exposition: one ``# TYPE`` line per family, all of
+    a family's samples contiguous under it, label values escaped. The
+    first type registered for a family wins (families are single-typed
+    by definition)."""
+
+    def __init__(self):
+        self._fams: dict[str, list] = {}  # family -> [type, [lines]]
+
+    def sample(self, family: str, suffix: str, labels, value,
+               mtype: str = "gauge") -> None:
+        fam = self._fams.setdefault(family, [mtype, []])
+        if isinstance(labels, str):
+            lab = labels  # pre-rendered "{...}" (caller escaped)
+        elif labels:
+            lab = "{" + ",".join(
+                f'{k}="{escape_label_value(v)}"'
+                for k, v in labels.items()) + "}"
+        else:
+            lab = ""
+        fam[1].append(f"{family}{suffix}{lab} {_fmt_value(value)}")
+
+    def emit(self, name: str, value, labels=None,
+             mtype: str | None = None) -> None:
+        if mtype is None:
+            # The repo-wide naming convention: *_total counters,
+            # last-value gauges otherwise.
+            mtype = "counter" if name.endswith("_total") else "gauge"
+        self.sample(name, "", labels, value, mtype)
+
+    def histogram(self, family: str, labels: dict, export: dict) -> None:
+        """One labeled series of a histogram family from a
+        StageHistogram.export() dict (runtime/trace.py): cumulative
+        ``_bucket`` samples, the mandatory ``le="+Inf"`` bucket, and the
+        ``_sum``/``_count`` samples — real Prometheus histogram shape,
+        consumable by histogram_quantile()."""
+        for le, c in export["buckets"]:
+            self.sample(family, "_bucket",
+                        {**labels, "le": format(le, ".9g")}, c,
+                        mtype="histogram")
+        self.sample(family, "_bucket", {**labels, "le": "+Inf"},
+                    export["count"], mtype="histogram")
+        self.sample(family, "_sum", labels, export["sum_s"],
+                    mtype="histogram")
+        self.sample(family, "_count", labels, export["count"],
+                    mtype="histogram")
+
+    def render(self) -> str:
+        out = []
+        for fam, (mtype, lines) in self._fams.items():
+            out.append(f"# TYPE {fam} {mtype}")
+            out.extend(lines)
+        return "\n".join(out) + "\n"
+
+
 def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    supervisor=None, quarantine=None,
-                   device_health=None, statics_store=None) -> str:
+                   device_health=None, statics_store=None,
+                   recorder=None) -> str:
     """Prometheus text exposition of the first-party metric contract
-    (SURVEY.md section 5.5), plus the north-star aggregation metrics."""
-    lines = []
-
-    def emit(name, value, labels=""):
-        lines.append(f"{name}{labels} {value}")
+    (SURVEY.md section 5.5), plus the north-star aggregation metrics and
+    the window flight recorder's stage histograms
+    (docs/observability.md). Every family carries a ``# TYPE`` line and
+    label values are escaped — tests/test_metrics_format.py holds the
+    output to a strict text-format parser."""
+    buf = _MetricsBuffer()
+    emit = buf.emit
 
     for p in profilers:
-        lab = f'{{profiler="{p.name}"}}'
-        emit("parca_agent_profiler_attempts_total", p.metrics.attempts_total, lab)
+        lab = {"profiler": p.name}
+        emit("parca_agent_profiler_attempts_total", p.metrics.attempts_total,
+             lab)
         emit("parca_agent_profiler_errors_total", p.metrics.errors_total, lab)
         emit("parca_agent_profiler_profiles_written_total",
              p.metrics.profiles_written, lab)
@@ -138,7 +215,7 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
         # Per-actor supervision state: restarts and liveness per actor,
         # plus the overall health as a 0/1/2 gauge (healthy/degraded/dead).
         for name, h in supervisor.health().items():
-            lab = f'{{actor="{name}"}}'
+            lab = {"actor": name}
             emit("parca_agent_actor_restarts_total", h["restarts"], lab)
             emit("parca_agent_actor_alive", int(h["alive"]), lab)
             emit("parca_agent_actor_degraded",
@@ -156,10 +233,10 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
         counts = quarantine.counts()
         for state in ("quarantined", "probation", "watched"):
             emit("parca_agent_quarantine_pids", counts[state],
-                 f'{{state="{state}"}}')
+                 {"state": state})
         for level in ("addresses", "scalar"):
             emit("parca_agent_quarantine_ladder_pids",
-                 counts[f"level_{level}"], f'{{level="{level}"}}')
+                 counts[f"level_{level}"], {"level": level})
         for k, v in quarantine.stats.items():
             emit(f"parca_agent_quarantine_{k}", v)
     if device_health is not None:
@@ -172,7 +249,7 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
 
         for state in STATES:
             emit("parca_agent_device_state",
-                 int(snap["state"] == state), f'{{state="{state}"}}')
+                 int(snap["state"] == state), {"state": state})
         emit("parca_agent_device_cooldown_windows",
              snap["cooldown_windows_left"])
         emit("parca_agent_device_shadow_pending",
@@ -195,9 +272,36 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
         emit("parca_agent_statics_snapshot_file_bytes", info["bytes"])
         if info["age_s"] is not None:
             emit("parca_agent_statics_snapshot_age_seconds", info["age_s"])
+    if recorder is not None:
+        # The window flight recorder (docs/observability.md): one REAL
+        # Prometheus histogram per lifecycle stage — the distribution the
+        # last-value duration gauges above cannot carry — plus compact
+        # percentile gauges (dashboards without histogram_quantile) and
+        # the recorder's own fail-open/incident counters.
+        hists = recorder.export_histograms()
+        for stage, h in hists.items():
+            buf.histogram("parca_agent_window_stage_duration_seconds",
+                          {"stage": stage}, h)
+        for stage, h in hists.items():
+            emit("parca_agent_window_stage_p50_seconds",
+                 round(h["p50_s"], 6), {"stage": stage})
+            emit("parca_agent_window_stage_p90_seconds",
+                 round(h["p90_s"], 6), {"stage": stage})
+            emit("parca_agent_window_stage_p99_seconds",
+                 round(h["p99_s"], 6), {"stage": stage})
+            emit("parca_agent_window_stage_max_seconds",
+                 round(h["max_s"], 6), {"stage": stage})
+        for k, v in recorder.stats.items():
+            name = f"parca_agent_trace_{k}"
+            emit(name if name.endswith("_total") else name + "_total", v)
     for k, v in (extra or {}).items():
-        emit(k, v)
-    return "\n".join(lines) + "\n"
+        # Extra metrics may arrive with pre-rendered labels
+        # ("name{k=\"v\"}"): split so the family still gets its TYPE
+        # line; the caller owns the escaping (cli.py uses
+        # escape_label_value).
+        name, brace, rest = k.partition("{")
+        buf.emit(name, v, labels=("{" + rest) if brace else None)
+    return buf.render()
 
 
 class AgentHTTPServer:
@@ -205,7 +309,7 @@ class AgentHTTPServer:
                  profilers=(), batch_client=None, listener=None,
                  version: str = "dev", extra_metrics=None,
                  capture_info=None, supervisor=None, quarantine=None,
-                 device_health=None, statics_store=None):
+                 device_health=None, statics_store=None, recorder=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -233,17 +337,64 @@ class AgentHTTPServer:
                         supervisor=outer.supervisor,
                         quarantine=outer.quarantine,
                         device_health=outer.device_health,
-                        statics_store=outer.statics_store).encode())
+                        statics_store=outer.statics_store,
+                        recorder=outer.recorder).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
                     self._healthz()
                 elif url.path == "/query":
                     self._query(url)
+                elif url.path == "/debug/windows":
+                    self._debug_windows(url)
+                elif url.path.startswith("/debug/trace/"):
+                    self._debug_trace(url)
                 elif url.path.startswith("/debug/pprof"):
                     self._debug_pprof(url)
                 else:
                     self._send(404, b"not found\n")
+
+            def _debug_windows(self, url):
+                """The window flight recorder's ring as wide-event JSON
+                (docs/observability.md): one object per completed window
+                trace, oldest first; ?limit=N caps the tail."""
+                if outer.recorder is None:
+                    self._send(503, b"window tracing not enabled\n")
+                    return
+                params = dict(urllib.parse.parse_qsl(url.query))
+                try:
+                    limit = int(params.get("limit", "0"))
+                except ValueError:
+                    limit = -1
+                if limit < 0:
+                    self._send(400, b"bad limit parameter\n")
+                    return
+                limit = limit or None
+                body = {
+                    "traces": outer.recorder.traces(limit=limit),
+                    "stats": dict(outer.recorder.stats),
+                    "stage_percentiles": outer.recorder.percentiles(),
+                }
+                self._send(200, json.dumps(body, indent=1).encode(),
+                           "application/json")
+
+            def _debug_trace(self, url):
+                """One window's trace by sequence number."""
+                if outer.recorder is None:
+                    self._send(503, b"window tracing not enabled\n")
+                    return
+                tail = url.path.removeprefix("/debug/trace/").strip("/")
+                try:
+                    seq = int(tail)
+                except ValueError:
+                    self._send(400, b"bad trace seq\n")
+                    return
+                got = outer.recorder.trace(seq)
+                if got is None:
+                    self._send(404, b"trace not in the ring\n")
+                    return
+                self._send(200, json.dumps(got, indent=1).encode(),
+                           "application/json")
 
             def _debug_pprof(self, url):
                 """Self-profiling endpoints (reference main.go:269-275):
@@ -377,6 +528,7 @@ class AgentHTTPServer:
         self.quarantine = quarantine
         self.device_health = device_health
         self.statics_store = statics_store
+        self.recorder = recorder
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info

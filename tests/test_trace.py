@@ -1,0 +1,488 @@
+"""Window flight recorder (runtime/trace.py, docs/observability.md).
+
+The contract under test: every window gets a trace with per-stage spans;
+completed traces land in a bounded ring and feed per-stage log-bucket
+histograms; a stage blowing its running-p99 budget auto-captures exactly
+one rate-limited incident (trace + self-profile + runtime context) as a
+crash-only JSON file; and the entire tracing path is FAIL-OPEN — an
+injected fault at ``trace.record`` or ``incident.dump`` never stalls or
+loses a window.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.aggregator.cpu import CPUAggregator
+from parca_agent_tpu.aggregator.dict import DictAggregator
+from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+from parca_agent_tpu.profiler.cpu import CPUProfiler
+from parca_agent_tpu.runtime.trace import (
+    MANDATORY_SPANS,
+    NULL_TRACE,
+    FlightRecorder,
+    StageHistogram,
+)
+from parca_agent_tpu.runtime import trace as trace_mod
+from parca_agent_tpu.utils import faults
+
+
+def _snap(seed=7, n_pids=6, rows=200):
+    return generate(SyntheticSpec(
+        n_pids=n_pids, n_unique_stacks=rows, n_rows=rows,
+        total_samples=rows * 4, mean_depth=8, kernel_fraction=0.25,
+        seed=seed))
+
+
+class ListSource:
+    """Capture source over a fixed list of snapshots; None at the end."""
+
+    def __init__(self, snaps):
+        self._snaps = list(snaps)
+
+    def poll(self):
+        return self._snaps.pop(0) if self._snaps else None
+
+
+class Collect:
+    def __init__(self):
+        self.got = []
+
+    def write(self, labels, blob):
+        self.got.append((labels, bytes(blob)))
+
+
+@pytest.fixture(autouse=True)
+def _no_global_state():
+    yield
+    faults.install(None)
+    trace_mod.install(None)
+
+
+# -- histogram ----------------------------------------------------------------
+
+
+def test_histogram_quantiles_and_max():
+    h = StageHistogram()
+    for ms in range(1, 101):  # 1..100 ms uniform
+        h.observe(ms / 1e3)
+    assert h.count == 100
+    assert h.max_s == pytest.approx(0.1)
+    # Log-bucket interpolation: within one 2x bucket of the true value.
+    assert 0.025 <= h.quantile(0.5) <= 0.1
+    assert h.quantile(0.99) <= h.max_s + 1e-9
+    assert h.quantile(0.99) >= h.quantile(0.5)
+    exp = h.export()
+    assert exp["buckets"][-1][1] == 100  # largest finite bucket holds all
+    assert exp["sum_s"] == pytest.approx(sum(range(1, 101)) / 1e3)
+
+
+def test_histogram_export_buckets_cumulative_monotone():
+    h = StageHistogram()
+    for d in (1e-6, 1e-3, 0.5, 10.0, 1e4):  # incl. one past the last bound
+        h.observe(d)
+    cum = [c for _, c in h.export()["buckets"]]
+    assert cum == sorted(cum)
+    assert h.export()["count"] == 5
+    assert cum[-1] == 4  # the 1e4 s observation lives in +Inf only
+
+
+# -- trace lifecycle ----------------------------------------------------------
+
+
+def test_trace_spans_ring_and_percentiles():
+    rec = FlightRecorder(ring=4)
+    for i in range(6):
+        tr = rec.begin(time_ns=1000 + i)
+        with tr.span("drain"):
+            pass
+        tr.add_span("close", 0.002)
+        tr.annotate(samples=10)
+        tr.complete()
+    traces = rec.traces()
+    assert len(traces) == 4                      # ring bound
+    assert traces[-1]["seq"] == 6                # trace id == window seq
+    assert traces[0]["seq"] == 3
+    stages = {s["stage"] for s in traces[-1]["spans"]}
+    assert {"drain", "close", "total"} <= stages
+    assert traces[-1]["meta"] == {"samples": 10}
+    assert rec.trace(5) is not None
+    assert rec.trace(1) is None                  # fell off the ring
+    pct = rec.percentiles()
+    assert pct["close"]["count"] == 6
+    assert pct["close"]["max_ms"] >= 2.0
+    assert rec.stats["traces_completed"] == 6
+
+
+def test_complete_is_idempotent_and_discard_skips_ring():
+    rec = FlightRecorder()
+    tr = rec.begin()
+    tr.complete()
+    tr.complete()
+    assert rec.stats["traces_completed"] == 1
+    tr2 = rec.begin()
+    tr2.discard()
+    assert rec.stats["traces_discarded"] == 1
+    assert len(rec.traces()) == 1
+
+
+def test_detached_trace_ignores_profiler_side_finish():
+    rec = FlightRecorder()
+    tr = rec.begin()
+    tr.detach()
+    tr.finish()                   # profiler end-of-iteration: no-op
+    assert rec.stats["traces_completed"] == 0
+    # An iteration error co-occurring with a successful hand-off (e.g.
+    # debuginfo upload failure) annotates — it must NOT complete the
+    # trace out from under the worker that owns it.
+    tr.finish(error="debuginfo upload failed")
+    assert rec.stats["traces_completed"] == 0
+    tr.complete(error="worker died")   # owner's completion still lands
+    assert rec.stats["traces_completed"] == 1
+    t = rec.traces()[0]
+    assert t["error"] == "worker died"
+    assert t["meta"]["iteration_error"] == "debuginfo upload failed"
+
+
+def test_zero_duration_stage_reports_zero_percentiles():
+    h = StageHistogram()
+    for _ in range(10):
+        h.observe(0.0)
+    assert h.quantile(0.5) == 0.0
+    assert h.quantile(0.99) == 0.0
+    assert h.max_s == 0.0
+
+
+def test_nohist_span_rides_the_trace_but_not_the_histogram():
+    rec = FlightRecorder()
+    tr = rec.begin()
+    tr.add_span("statics", 0.02, histogram=False)
+    tr.add_span("encode", 0.01)
+    tr.complete()
+    pct = rec.percentiles()
+    assert "statics" not in pct          # histogram untouched
+    assert pct["encode"]["count"] == 1
+    stages = {s["stage"] for s in rec.traces()[0]["spans"]}
+    assert "statics" in stages           # wide event keeps the span
+    assert "nohist" not in rec.traces()[0]["spans"][0]
+
+
+def test_span_context_manager_records_error_and_reraises():
+    rec = FlightRecorder()
+    tr = rec.begin()
+    with pytest.raises(ValueError):
+        with tr.span("drain"):
+            raise ValueError("boom")
+    tr.complete(error="boom")
+    t = rec.traces()[0]
+    drain = next(s for s in t["spans"] if s["stage"] == "drain")
+    assert "boom" in drain["error"]
+    assert t["error"] == "boom"
+
+
+# -- fail-open tracing (chaos) ------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_trace_record_fault_is_swallowed_and_counted():
+    faults.install(faults.FaultInjector.from_spec("trace.record:error"))
+    rec = FlightRecorder()
+    tr = rec.begin()              # begin itself rides the site
+    assert tr is NULL_TRACE
+    rec.observe("batch_flush", 0.01)
+    assert rec.stats["record_errors"] >= 2
+    faults.install(None)
+    tr = rec.begin()
+    tr.complete()
+    assert rec.stats["traces_completed"] == 1
+
+
+@pytest.mark.chaos
+def test_tracing_fault_never_stalls_or_loses_a_window():
+    """The acceptance bar: with trace.record firing on EVERY recording,
+    all windows still aggregate, encode, and ship (fail-open), and the
+    faults are visible as counted record errors."""
+    faults.install(faults.FaultInjector.from_spec("trace.record:error"))
+    rec = FlightRecorder()
+    snaps = [_snap(seed=i) for i in range(3)]
+    sink = Collect()
+    prof = CPUProfiler(
+        source=ListSource(snaps), aggregator=DictAggregator(capacity=1 << 12),
+        fallback_aggregator=CPUAggregator(), profile_writer=sink,
+        duration_s=0.0, fast_encode=True, encode_pipeline=True,
+        trace_recorder=rec)
+    prof.run()
+    assert prof.crashed is None
+    assert prof.last_error is None
+    assert prof.metrics.attempts_total == 3
+    assert prof.metrics.profiles_written > 0
+    assert prof._pipeline.stats["windows_lost"] == 0
+    assert rec.stats["record_errors"] > 0
+    assert faults.get().stats().get("trace.record", 0) > 0
+    # Nothing could be recorded, so nothing ringed — but nothing lost.
+    assert rec.stats["traces_completed"] == 0
+
+
+# -- profiler integration -----------------------------------------------------
+
+
+def test_profiler_pipelined_traces_carry_mandatory_spans():
+    rec = FlightRecorder()
+    snaps = [_snap(seed=i) for i in range(4)]
+    sink = Collect()
+    prof = CPUProfiler(
+        source=ListSource(snaps), aggregator=DictAggregator(capacity=1 << 12),
+        fallback_aggregator=CPUAggregator(), profile_writer=sink,
+        duration_s=0.0, fast_encode=True, encode_pipeline=True,
+        trace_recorder=rec)
+    prof.run()
+    assert prof.crashed is None and prof.last_error is None
+    traces = rec.traces()
+    assert len(traces) == 4
+    for t in traces:
+        assert t["complete"] and "error" not in t
+        stages = {s["stage"] for s in t["spans"]}
+        assert set(MANDATORY_SPANS) <= stages, (t["seq"], stages)
+        assert t["meta"]["path"] == "pipeline"
+        assert t["meta"]["samples"] > 0
+    # The stage histograms exist for every mandatory stage + total.
+    pct = rec.percentiles()
+    for stage in (*MANDATORY_SPANS, "total"):
+        assert pct[stage]["count"] == 4, stage
+
+
+def test_gauges_and_histograms_agree():
+    """Satellite contract: the last-value gauges are set FROM the same
+    measurements the histograms record, so they cannot disagree."""
+    rec = FlightRecorder()
+    snaps = [_snap(seed=i) for i in range(2)]
+    prof = CPUProfiler(
+        source=ListSource(snaps), aggregator=DictAggregator(capacity=1 << 12),
+        fallback_aggregator=CPUAggregator(), profile_writer=Collect(),
+        duration_s=0.0, fast_encode=True, encode_pipeline=True,
+        trace_recorder=rec)
+    prof.run()
+    last = rec.traces()[-1]
+    by_stage = {s["stage"]: s for s in last["spans"]}
+    assert by_stage["close"]["duration_s"] == pytest.approx(
+        prof.metrics.last_aggregate_duration_s, abs=1e-6)
+    assert by_stage["encode"]["duration_s"] == pytest.approx(
+        prof._pipeline.stats["last_encode_s"], abs=1e-6)
+    assert by_stage["ship"]["duration_s"] == pytest.approx(
+        prof._pipeline.stats["last_ship_s"], abs=1e-6)
+
+
+def test_profiler_scalar_path_traces():
+    rec = FlightRecorder()
+    prof = CPUProfiler(
+        source=ListSource([_snap(seed=1)]), aggregator=CPUAggregator(),
+        profile_writer=Collect(), duration_s=0.0, trace_recorder=rec)
+    prof.run()
+    t = rec.traces()[0]
+    stages = {s["stage"] for s in t["spans"]}
+    assert {"drain", "close", "ship", "total"} <= stages
+    assert t["meta"]["path"] == "scalar"
+
+
+def test_poll_failure_completes_trace_with_error():
+    class BadSource:
+        def __init__(self):
+            self.polled = 0
+
+        def poll(self):
+            self.polled += 1
+            if self.polled == 1:
+                raise OSError("ring gone")
+            return None
+
+    rec = FlightRecorder()
+    prof = CPUProfiler(source=BadSource(), aggregator=CPUAggregator(),
+                       duration_s=0.0, trace_recorder=rec)
+    prof.run()
+    traces = rec.traces()
+    assert len(traces) == 1
+    assert "ring gone" in traces[0]["error"]
+    assert rec.stats["traces_discarded"] == 1  # the end-of-source poll
+
+
+# -- slow-window detection / incidents ---------------------------------------
+
+
+def _primed_recorder(tmp_path, **kw):
+    rec = FlightRecorder(
+        incident_dir=str(tmp_path / "incidents"), min_count=4,
+        # Production-scale floor: the real begin->complete wall time of
+        # the synthetic windows feeds the 'total' histogram, so a floor
+        # near the test's ~us scale turns any scheduler hiccup into a
+        # false incident (load-flaky under the full suite).
+        min_duration_s=0.05, slow_multiple=5.0,
+        context=lambda: {"supervisor": {"profiler": "healthy"}},
+        self_profile=lambda: b"\x1f\x8bFAKEPPROF", **kw)
+    for i in range(6):
+        tr = rec.begin()
+        tr.add_span("close", 0.002)
+        tr.complete()
+    return rec
+
+
+def _wait_incidents(rec, tmp_path, n, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    d = str(tmp_path / "incidents")
+    while time.monotonic() < deadline:
+        done = rec.stats["incidents_written"] + rec.stats["incidents_failed"]
+        if done >= n and not rec._dumping:
+            break
+        time.sleep(0.01)
+    return sorted(os.listdir(d)) if os.path.isdir(d) else []
+
+
+def test_slow_window_captures_exactly_one_incident(tmp_path):
+    rec = _primed_recorder(tmp_path)
+    tr = rec.begin()
+    tr.add_span("close", 0.5)      # 250x the primed p99
+    tr.complete()
+    files = _wait_incidents(rec, tmp_path, 1)
+    assert len(files) == 1
+    assert rec.stats["incidents_written"] == 1
+    assert rec.stats["slow_spans_total"] >= 1
+    body = json.loads((tmp_path / "incidents" / files[0]).read_text())
+    assert body["kind"] == "slow_window"
+    assert body["stage"] == "close"
+    assert body["trace"]["seq"] == tr.seq
+    assert any(s.get("slow") for s in body["trace"]["spans"])
+    assert body["duration_s"] == pytest.approx(0.5)
+    assert body["budget_s"] > 0
+    assert body["context"] == {"supervisor": {"profiler": "healthy"}}
+    assert base64.b64decode(
+        body["self_profile_pprof_gz_b64"]) == b"\x1f\x8bFAKEPPROF"
+    assert "close" in body["stage_percentiles"]
+    # The slow trace is still a normal ring citizen.
+    assert rec.trace(tr.seq)["meta"]["slow_stage"] == "close"
+
+
+def test_second_slow_window_is_rate_limited(tmp_path):
+    rec = _primed_recorder(tmp_path, incident_interval_s=3600.0)
+    # Escalating durations so the SECOND one still breaches the p99
+    # budget the first one just inflated.
+    for dur in (0.5, 30.0):
+        tr = rec.begin()
+        tr.add_span("close", dur)
+        tr.complete()
+    files = _wait_incidents(rec, tmp_path, 1)
+    assert len(files) == 1
+    assert rec.stats["incidents_suppressed"] >= 1
+
+
+def test_global_stage_stall_captures_incident(tmp_path):
+    """'Any traced stage': a transport stage observed via observe() (no
+    per-window trace) rides the same detector and dump machinery."""
+    rec = FlightRecorder(
+        incident_dir=str(tmp_path / "incidents"), min_count=4,
+        min_duration_s=0.001, context=lambda: {},
+        self_profile=lambda: b"p")
+    for _ in range(6):
+        rec.observe("batch_flush", 0.002)
+    rec.observe("batch_flush", 1.0)
+    files = _wait_incidents(rec, tmp_path, 1)
+    assert len(files) == 1
+    body = json.loads((tmp_path / "incidents" / files[0]).read_text())
+    assert body["stage"] == "batch_flush"
+    assert body["trace"] is None
+
+
+def test_fast_windows_capture_nothing(tmp_path):
+    rec = _primed_recorder(tmp_path)
+    for _ in range(10):
+        tr = rec.begin()
+        tr.add_span("close", 0.002)
+        tr.complete()
+    assert _wait_incidents(rec, tmp_path, 0, timeout=0.3) == []
+    assert rec.stats["incidents_written"] == 0
+    assert rec.stats["slow_spans_total"] == 0
+
+
+@pytest.mark.chaos
+def test_incident_dump_fault_costs_the_file_not_the_window(tmp_path):
+    faults.install(faults.FaultInjector.from_spec("incident.dump:error"))
+    rec = _primed_recorder(tmp_path)
+    tr = rec.begin()
+    tr.add_span("close", 0.5)
+    tr.complete()
+    _wait_incidents(rec, tmp_path, 1)
+    assert rec.stats["incidents_failed"] == 1
+    assert rec.stats["incidents_written"] == 0
+    assert os.listdir(tmp_path / "incidents") == []
+    # The window itself completed normally into the ring.
+    assert rec.trace(tr.seq)["complete"]
+
+
+def test_incident_files_pruned_to_cap(tmp_path):
+    rec = _primed_recorder(tmp_path, incident_interval_s=0.0,
+                           max_incidents=2)
+    for _ in range(4):
+        tr = rec.begin()
+        tr.add_span("close", 0.5)
+        tr.complete()
+        _wait_incidents(rec, tmp_path, rec.stats["incidents_written"] + 1,
+                        timeout=2.0)
+        time.sleep(0.02)  # distinct timestamps keep prune order honest
+    files = _wait_incidents(rec, tmp_path, 4)
+    assert len(files) <= 2
+
+
+# -- the module-global hook ---------------------------------------------------
+
+
+def test_module_observe_is_free_without_recorder():
+    trace_mod.install(None)
+    trace_mod.observe("batch_flush", 1.0)  # no-op, no error
+    rec = FlightRecorder()
+    trace_mod.install(rec)
+    trace_mod.observe("batch_flush", 0.5)
+    assert rec.percentiles()["batch_flush"]["count"] == 1
+    trace_mod.install(None)
+
+
+@pytest.mark.chaos
+def test_failed_spool_spill_is_still_observed(tmp_path):
+    """A slow-then-failing disk is exactly the stall the spool_spill
+    histogram exists to explain: the failure path observes too."""
+    from parca_agent_tpu.agent.profilestore import RawSeries
+    from parca_agent_tpu.agent.spool import SpoolDir
+
+    rec = FlightRecorder()
+    trace_mod.install(rec)
+    try:
+        faults.install(faults.FaultInjector.from_spec(
+            "spool.write:disk_full"))
+        spool = SpoolDir(str(tmp_path / "spool"))
+        assert not spool.append([RawSeries({"a": "b"}, [b"x"])])
+        assert rec.percentiles()["spool_spill"]["count"] == 1
+    finally:
+        trace_mod.install(None)
+
+
+def test_encoder_statics_build_feeds_global_histogram():
+    rec = FlightRecorder()
+    trace_mod.install(rec)
+    try:
+        from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+
+        snap = _snap(seed=3)
+        agg = DictAggregator(capacity=1 << 12)
+        counts = np.asarray(agg.window_counts(snap))
+        enc = WindowEncoder(agg)
+        enc.build_statics(snap.period_ns)
+        assert enc.stats["last_statics_build_s"] > 0
+        assert enc.stats["statics_build_s_total"] >= \
+            enc.stats["last_statics_build_s"]
+        assert rec.percentiles()["statics"]["count"] >= 1
+        enc.encode(counts, snap.time_ns, snap.window_ns, snap.period_ns)
+    finally:
+        trace_mod.install(None)
