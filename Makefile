@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-live chaos fuzz bench bench-statics trace-smoke fixtures golden clean install
+.PHONY: all native test test-live chaos fuzz bench bench-statics bench-close trace-smoke fixtures golden clean install
 
 all: native
 
@@ -26,7 +26,7 @@ test-live:
 # outages, disk-full spill, actor crashes, device/fleet hangs —
 # deterministic by design, so it also rides every unmarked run.
 chaos:
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the
@@ -44,6 +44,13 @@ bench:
 # degradation bars. Host-bound, so it pins the cpu backend.
 bench-statics:
 	JAX_PLATFORMS=cpu PARCA_BENCH_STATICS_CHILD=1 $(PYTHON) bench.py
+
+# The sub-RTT close drill alone (docs/perf.md "sub-RTT close"):
+# double-buffer overlap, delta-fetch byte accounting, and the Pallas
+# batch-probe kernel vs the lax sort, gated on pprof byte identity.
+# Host-bound (interpret-mode Pallas), so it pins the cpu backend.
+bench-close:
+	JAX_PLATFORMS=cpu PARCA_BENCH_CLOSE_CHILD=1 $(PYTHON) bench.py
 
 # Window flight-recorder smoke (docs/observability.md): a short traced
 # session must expose >=3 complete traces with every mandatory span on

@@ -612,6 +612,22 @@ def run(emit=None) -> dict:
         _progress(f"trace overhead drill done: {phase}")
         _emit_partial()
 
+    # Sub-RTT close drill (docs/perf.md "sub-RTT close"): double-buffer
+    # overlap, delta-fetch byte accounting, and the Pallas batch-probe
+    # kernel, all gated on pprof byte identity. Reduced-scale and
+    # host-bound (interpret-mode Pallas): it cannot hang the attempt.
+    if os.environ.get("PARCA_BENCH_CLOSE", "1") != "0" \
+            and _budget_left(0.12, "close_overlap"):
+        try:
+            phase = _close_overlap()
+        except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+            phase = {"error": repr(e)[:300]}
+        _finalize_result(phase, device_alive=True,
+                         require_full_scale=False, require_device=False)
+        extras["close_overlap"] = phase
+        _progress(f"close overlap drill done: {phase}")
+        _emit_partial()
+
     # Fully-synchronous one-shot boundary, for reference (rides the same
     # feed + packed-close programs; n_pad differs, so the whole-window
     # feed shape may compile here — intentionally after the headline).
@@ -1081,6 +1097,194 @@ def _trace_overhead() -> dict:
         phase["error"] = (f"A/B paired difference {ab_diff_ms:.3f} ms "
                           f"contradicts the microbench beyond noise "
                           f"(bar {ab_slack_ms:.3f} ms)")
+    return phase
+
+
+def _close_overlap() -> dict:
+    """Sub-RTT close drill (docs/perf.md "sub-RTT close"): the
+    double-buffered window accumulator, delta-fetch, and the Pallas
+    batch-probe kernel, with exactness enforced at the pprof byte level.
+
+    Four measurements, one identity gate:
+
+      * Overlap: a steady-state hot-set window fed in drain-sized chunks
+        through two arms — SYNC (each feed settles its miss check
+        inline, the pre-PR behavior) vs ASYNC (dispatch-only feeds, the
+        deferred settle rides the next drain). feed_stall_ms is the
+        async arm's capture-thread cost per window (bar: <= 5 ms at
+        reduced scale); feed_overlap_ms is the device work the deferral
+        moved OFF the capture thread (sync minus async).
+      * Delta-fetch: the delta arm's steady-state close must move < 25%
+        of the full close's fetched bytes (the rows/bytes percentages
+        ride out), with the first hot window exercising the documented
+        grow-on-misprediction retry.
+      * Byte identity: all arms (full-fetch baseline, delta + overlap
+        split-close, Pallas feed probe when available) encode every
+        window through their own WindowEncoder; the pprof bytes must be
+        identical across arms, window by window.
+      * Batch kernel: the one-shot kernel's location dedup as hash-table
+        build+probe (Pallas, interpret on CPU) vs the lax sort path, on
+        the same window — timed, and the pprof bytes must match.
+
+    Reduced-scale and host-bound by design (interpret-mode Pallas on the
+    cpu backend exercises the same kernel code Mosaic compiles on a
+    TPU); rides the same mechanical scoring stamp as the headline."""
+    import hashlib as _hl
+
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+    from parca_agent_tpu.aggregator.pallas_probe import pallas_available
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+    from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+
+    rows = int(os.environ.get("PARCA_BENCH_CLOSE_ROWS", 1 << 14))
+    n_windows = int(os.environ.get("PARCA_BENCH_CLOSE_WINDOWS", 6))
+    # Counts stay small (~3 per row) so the close packs at width 4 with
+    # a thin overflow sideband — the steady-state shape the delta-fetch
+    # byte accounting is designed around (a 5M-sample synthetic would
+    # overflow every row and measure the sideband, not the delta).
+    snap = generate(SyntheticSpec(
+        n_pids=256, n_unique_stacks=rows, n_rows=rows,
+        total_samples=rows * 3, mean_depth=12, seed=77))
+    total = snap.total_samples()
+    cap = 1 << max(14, (4 * rows - 1).bit_length())
+    chunk = 1 << 12  # one capture drain's worth of rows per feed
+    # The steady-state hot set: ~12.5% of the population, contiguous in
+    # insertion order (a pid's stacks get consecutive ids), the locality
+    # the touched-block tracking is built for.
+    hot_lo, hot_hi = rows // 8, rows // 8 + rows // 8
+
+    use_pallas = pallas_available()
+    arms = {
+        "full": DictAggregator(capacity=cap, overflow="raise",
+                               delta_fetch=False),
+        "delta": DictAggregator(capacity=cap, overflow="raise",
+                                delta_fetch=True),
+    }
+    if use_pallas:
+        arms["pallas"] = DictAggregator(capacity=cap, overflow="raise",
+                                        probe_backend="pallas")
+    encs = {k: WindowEncoder(a) for k, a in arms.items()}
+    hashes = {k: a.hash_rows(snap) for k, a in arms.items()}
+
+    def feed_range(a, k, lo, hi):
+        for c0 in range(lo, hi, chunk):
+            a.feed(snap, hashes[k], c0, min(c0 + chunk, hi))
+
+    def encode_digest(k, counts, w):
+        out = encs[k].encode(counts, 1_000 + w, 10**10, 10**7)
+        h = _hl.sha256()
+        for pid, blob in out:
+            h.update(str(pid).encode())
+            h.update(blob)
+        return h.hexdigest()
+
+    # Window 0: population insert (every stack is a miss; the delta arm
+    # learns its touched-block history from the full close's flags).
+    digests: dict[str, list] = {k: [] for k in arms}
+    for k, a in arms.items():
+        feed_range(a, k, 0, rows)
+        c = a.close_window()
+        assert int(c.sum()) == total
+        digests[k].append(encode_digest(k, c, 0))
+
+    sync_ms, async_ms, stall_samples = [], [], []
+    for w in range(1, n_windows + 1):
+        for k, a in arms.items():
+            t0 = time.perf_counter()
+            feed_range(a, k, hot_lo, hot_hi)
+            feed_s = time.perf_counter() - t0
+            if k == "full":
+                # SYNC arm: settle the deferred miss check inline, the
+                # way every feed paid for it before the deferral.
+                t1 = time.perf_counter()
+                a._settle_misses()
+                sync_ms.append((feed_s + time.perf_counter() - t1) * 1e3)
+            elif k == "delta":
+                async_ms.append(feed_s * 1e3)
+            if k == "delta" and w >= 2:
+                # Steady state: the split close — pack dispatched, the
+                # buffers flipped, the NEXT window's first drain fed
+                # (landing in the twin), only then the fetch collected.
+                h = a.close_dispatch()
+                t2 = time.perf_counter()
+                a.feed(snap, hashes[k], hot_lo, min(hot_lo + chunk, hot_hi))
+                stall_samples.append((time.perf_counter() - t2) * 1e3)
+                c = a.close_collect(h)
+                a.discard_open_window()  # drop the probe feed's mass
+            else:
+                c = a.close_window()
+            digests[k].append(encode_digest(k, c, w))
+
+    identical = all(digests[k] == digests["full"] for k in arms)
+    dstats = arms["delta"].stats
+    full_rows = arms["full"].stats.get("fetch_rows_last", 0)
+    full_bytes = arms["full"].stats.get("fetch_bytes_last", 0)
+    delta_rows = dstats.get("fetch_rows_last", 0)
+    delta_bytes = dstats.get("fetch_bytes_last", 0)
+    rows_pct = round(100.0 * delta_rows / max(full_rows, 1), 1)
+    bytes_pct = round(100.0 * delta_bytes / max(full_bytes, 1), 1)
+    stall_ms = float(np.median(async_ms))
+    overlap_ms = max(0.0, float(np.median(sync_ms)) - stall_ms)
+
+    phase = {
+        "windows": n_windows,
+        "rows": rows,
+        "feed_stall_ms": round(stall_ms, 3),
+        "feed_overlap_ms": round(overlap_ms, 3),
+        "feed_sync_ms": round(float(np.median(sync_ms)), 3),
+        "mid_flip_feed_stall_ms": round(float(np.median(stall_samples)), 3)
+        if stall_samples else None,
+        "delta_fetch_rows_pct": rows_pct,
+        "delta_fetch_bytes_pct": bytes_pct,
+        "delta_closes": dstats.get("delta_closes", 0),
+        "delta_retries": dstats.get("delta_retries", 0),
+        "buffer_flips": dstats.get("buffer_flips", 0),
+        "pallas": use_pallas,
+        "bytes_identical": identical,
+    }
+
+    # The batch kernel's location dedup: hash-table (Pallas) vs sort.
+    from parca_agent_tpu.aggregator.tpu import TPUAggregator
+    from parca_agent_tpu.pprof.builder import build_pprof
+
+    bsnap = generate(SyntheticSpec(
+        n_pids=64, n_unique_stacks=2048, n_rows=2048,
+        total_samples=8192, mean_depth=8, seed=78))
+
+    def batch_arm(dedup):
+        ta = TPUAggregator()
+        ta.dedup = dedup
+        ta.aggregate(bsnap)  # compile
+        t0 = time.perf_counter()
+        profs = ta.aggregate(bsnap)
+        ms = (time.perf_counter() - t0) * 1e3
+        h = _hl.sha256()
+        for p in sorted(profs, key=lambda p: p.pid):
+            h.update(build_pprof(p, compress=False))
+        return round(ms, 1), h.hexdigest(), ta._hash_disabled
+
+    sort_ms, sort_digest, _ = batch_arm("sort")
+    phase["batch_kernel_lax_ms"] = sort_ms
+    if use_pallas:
+        hash_ms, hash_digest, hash_fell_back = batch_arm("hash")
+        phase["batch_kernel_pallas_ms"] = hash_ms
+        phase["batch_kernel_identical"] = hash_digest == sort_digest
+        if hash_fell_back:
+            phase["error"] = "hash dedup fell back to sort at runtime"
+        elif hash_digest != sort_digest:
+            phase["error"] = "hash vs sort batch kernel pprof mismatch"
+
+    if not identical:
+        phase["error"] = "pprof bytes differ across close arms"
+    elif not dstats.get("delta_closes"):
+        phase["error"] = "delta-fetch never engaged on the steady state"
+    elif bytes_pct >= 25.0:
+        phase["error"] = (f"delta close moved {bytes_pct}% of the full "
+                          f"fetch's bytes (bar < 25%)")
+    elif stall_ms > 5.0:
+        phase.setdefault("error",
+                         f"capture-thread feed stall {stall_ms:.2f} ms "
+                         f"(bar <= 5 ms at reduced scale)")
     return phase
 
 
@@ -1583,6 +1787,22 @@ def _statics_main() -> None:
     print(json.dumps({"metric": "cold_restart_statics", **phase}))
 
 
+def _close_main() -> None:
+    """`make bench-close`: the close_overlap drill alone, host-scale,
+    one JSON line. Runs on whatever backend the env pins (the Make
+    target pins cpu — the drill is interpret-mode by design)."""
+    try:
+        phase = _close_overlap()
+    except Exception as e:  # noqa: BLE001 - the line must still print
+        phase = {"error": repr(e)[:300]}
+    import jax
+
+    phase["backend"] = jax.default_backend()
+    _finalize_result(phase, device_alive=True,
+                     require_full_scale=False, require_device=False)
+    print(json.dumps({"metric": "close_overlap", **phase}))
+
+
 def _child_main() -> None:
     """The measurement process: no supervision, just run and print."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
@@ -1603,6 +1823,9 @@ def _child_main() -> None:
 def main() -> None:
     if os.environ.get("PARCA_BENCH_STATICS_CHILD"):
         _statics_main()
+        return
+    if os.environ.get("PARCA_BENCH_CLOSE_CHILD"):
+        _close_main()
         return
     if os.environ.get("PARCA_BENCH_PROBE_CHILD"):
         _probe_main()

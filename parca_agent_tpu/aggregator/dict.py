@@ -57,7 +57,8 @@ from parca_agent_tpu.ops.hashing import row_hash_np
 _PROBES = 16
 
 
-def make_feed(cap: int, id_cap: int, n_pad: int):
+def make_feed(cap: int, id_cap: int, n_pad: int, n_blocks: int = 0,
+              blk: int = 0, probe=None):
     """Pure (unjitted) streaming-window accumulate: batched linear-probe
     lookup of all rows against the device stack dictionary, scatter-adding
     hits into a persistent device accumulator.
@@ -66,55 +67,80 @@ def make_feed(cap: int, id_cap: int, n_pad: int):
     BPF stack_counts map absorbs samples DURING the window so window close
     is cheap, bpf/cpu/cpu.bpf.c:110-116): capture drains feed the device
     once a second, so the host<->device traffic rides the idle window and
-    close only has to pack + fetch."""
+    close only has to pack + fetch.
+
+    With n_blocks > 0 the feed also maintains a touched-block flag array
+    (one int32 per `blk` consecutive stack ids): every accumulated hit
+    marks its id's block, and the delta close (make_close_delta) fetches
+    only marked blocks. `probe`, when given, replaces the inline lax
+    probe loop (same semantics — the Pallas re-expression from
+    aggregator/pallas_probe.py plugs in here)."""
     import jax
     import jax.numpy as jnp
 
-    def feed(table, acc, packed, reset):
+    def feed(table, acc, touch, packed, reset):
         # reset != 0: this is the first feed of a new window; the previous
         # window's accumulator contents (kept across close for lossless
-        # retry) are discarded here, on device.
+        # retry) are discarded here, on device — touch flags with them.
         acc = jnp.where(reset != 0, 0, acc)
+        if n_blocks:
+            touch = jnp.where(reset != 0, 0, touch)
         h1, h2, h3 = packed[0], packed[1], packed[2]
         cnt = packed[3].astype(jnp.int32)
-        mask = jnp.uint32(cap - 1)
 
-        def probe(k, state):
-            found_id, done = state
-            idx = ((h1 + jnp.uint32(k)) & mask).astype(jnp.int32)
-            row = table[idx]
-            occ = row[:, 3] > 0
-            hit = occ & (row[:, 0] == h1) & (row[:, 1] == h2) \
-                & (row[:, 2] == h3)
-            stop = hit | ~occ
-            found_id = jnp.where(hit & ~done,
-                                 row[:, 3].astype(jnp.int32) - 1, found_id)
-            return found_id, done | stop
+        if probe is not None:
+            found_id = probe(table, h1, h2, h3)
+        else:
+            mask = jnp.uint32(cap - 1)
 
-        found_id = jnp.full(h1.shape, -1, jnp.int32)
-        done = jnp.zeros(h1.shape, bool)
-        found_id, _ = jax.lax.fori_loop(0, _PROBES, probe, (found_id, done))
+            def step(k, state):
+                found_id, done = state
+                idx = ((h1 + jnp.uint32(k)) & mask).astype(jnp.int32)
+                row = table[idx]
+                occ = row[:, 3] > 0
+                hit = occ & (row[:, 0] == h1) & (row[:, 1] == h2) \
+                    & (row[:, 2] == h3)
+                stop = hit | ~occ
+                found_id = jnp.where(hit & ~done,
+                                     row[:, 3].astype(jnp.int32) - 1,
+                                     found_id)
+                return found_id, done | stop
+
+            found_id = jnp.full(h1.shape, -1, jnp.int32)
+            done = jnp.zeros(h1.shape, bool)
+            found_id, _ = jax.lax.fori_loop(0, _PROBES, step,
+                                            (found_id, done))
 
         live = cnt > 0
         hit = (found_id >= 0) & live
         acc = acc.at[jnp.where(hit, found_id, id_cap)].add(
             cnt, mode="drop")
+        if n_blocks:
+            touch = touch.at[jnp.where(hit, found_id // blk,
+                                       n_blocks)].set(1, mode="drop")
         miss = live & ~hit
         mtgt = jnp.where(miss, jnp.cumsum(miss.astype(jnp.int32)) - 1,
                          jnp.int32(n_pad))
         miss_rows = jnp.full((n_pad,), -1, jnp.int32).at[mtgt].set(
             jnp.arange(h1.shape[0], dtype=jnp.int32), mode="drop")
         n_miss = miss.astype(jnp.int32).sum()
-        return acc, n_miss, miss_rows
+        return acc, touch, n_miss, miss_rows
 
     return feed
 
 
 @functools.lru_cache(maxsize=8)
-def _feed_program(cap: int, id_cap: int, n_pad: int):
+def _feed_program(cap: int, id_cap: int, n_pad: int, n_blocks: int,
+                  blk: int, backend: str):
     import jax
 
-    return jax.jit(make_feed(cap, id_cap, n_pad), donate_argnums=(1,))
+    probe = None
+    if backend == "pallas":
+        from parca_agent_tpu.aggregator.pallas_probe import make_batch_probe
+
+        probe = make_batch_probe(cap, _PROBES)
+    return jax.jit(make_feed(cap, id_cap, n_pad, n_blocks, blk, probe),
+                   donate_argnums=(1, 2))
 
 
 # Overflow sideband caps for the packed close fetch: ids whose window
@@ -183,6 +209,114 @@ def _close_program(id_cap: int, n_fetch: int, width: int,
     return jax.jit(make_close(id_cap, n_fetch, width, n_over_buf))
 
 
+# Delta-fetch granularity: stack ids per touched-block flag. A multiple
+# of every pack width's per32 (8 at width 4), small enough that a hot
+# working set with the usual insertion-order locality (a pid's stacks
+# get consecutive ids) fetches tight block runs, large enough that the
+# flag array stays trivial (id_cap/128 int32s = 32 KB at 1M ids).
+_DELTA_BLOCK = 128
+# Delta fetch must move strictly less than half the full fetch's rows to
+# be worth its second buffer dimension; past this the full close is used.
+_DELTA_MAX_FRAC = 0.5
+
+
+def make_close_delta(id_cap: int, n_fetch: int, width: int,
+                     n_over_buf: int, n_blk_buf: int, blk: int):
+    """Pure (unjitted) delta window close: pack ONLY the touched blocks
+    of the accumulator (rows written since the window opened — the feed
+    marks them, make_feed) at uint{width}, with the same exact
+    (id, count) overflow sideband as make_close. The accumulator is left
+    intact, so every misprediction retries against it losslessly.
+
+    Output is ONE uint32 buffer:
+      [ n_blk_buf*blk*width/32 lanes : packed counts of touched blocks
+      | n_blk_buf                    : touched block ids (nb_prefix = none)
+      | n_over_buf                   : overflow GLOBAL ids (n_fetch = none)
+      | n_over_buf                   : overflow counts
+      | 1 : n_touched blocks (may exceed n_blk_buf: grow / full retry)
+      | 1 : n_overflow (may exceed n_over_buf: grow-then-widen retry)
+      | 1 : count mass in UNTOUCHED prefix blocks (exactness guard; 0)
+      | 1 : count mass beyond n_fetch (guard; 0) ]
+    """
+    import jax
+    import jax.numpy as jnp
+
+    assert width in (4, 8, 16)
+    assert n_fetch % blk == 0
+    sentinel = (1 << width) - 1
+    per32 = 32 // width
+    nb_prefix = n_fetch // blk
+
+    def close(acc, touch):
+        t = touch[:nb_prefix] > 0
+        n_touched = t.astype(jnp.uint32).sum()
+        tgt = jnp.where(t, jnp.cumsum(t.astype(jnp.int32)) - 1,
+                        jnp.int32(n_blk_buf))
+        blk_ids = jnp.full((n_blk_buf,), jnp.uint32(nb_prefix)).at[tgt].set(
+            jnp.arange(nb_prefix, dtype=jnp.uint32), mode="drop")
+        live_b = blk_ids < nb_prefix
+        safe = jnp.minimum(blk_ids, nb_prefix - 1).astype(jnp.int32)
+        gidx = safe[:, None] * blk + jnp.arange(blk, dtype=jnp.int32)[None, :]
+        vals = jnp.where(live_b[:, None], acc[gidx], 0).reshape(-1)
+        over = vals > (sentinel - 1)
+        pk = jnp.where(over, sentinel, vals).astype(jnp.uint32)
+        shifts = (jnp.arange(per32, dtype=jnp.uint32) * width)[None, :]
+        lanes = (pk.reshape(-1, per32) << shifts).sum(axis=1,
+                                                      dtype=jnp.uint32)
+        gid = gidx.reshape(-1).astype(jnp.uint32)
+        otgt = jnp.where(over, jnp.cumsum(over.astype(jnp.int32)) - 1,
+                         jnp.int32(n_over_buf))
+        over_id = jnp.full((n_over_buf,), jnp.uint32(n_fetch)).at[otgt].set(
+            gid, mode="drop")
+        over_val = jnp.zeros((n_over_buf,), jnp.uint32).at[otgt].set(
+            vals.astype(jnp.uint32), mode="drop")
+        n_over = over.astype(jnp.uint32).sum()
+        # Exactness guards: untouched prefix blocks and the tail beyond
+        # n_fetch must both carry zero mass (the acc resets at window
+        # open and the feed marks every add). A nonzero guard means the
+        # touch tracking missed a write — the host falls back to the
+        # full fetch, so a guard trip can degrade speed, never counts.
+        blk_mass = acc[:n_fetch].reshape(nb_prefix, blk).sum(axis=1)
+        untouched = jnp.where(~t, blk_mass, 0).sum().astype(jnp.uint32)
+        tail = acc[n_fetch:].sum().astype(jnp.uint32)
+        return jnp.concatenate([
+            lanes, blk_ids, over_id, over_val,
+            n_touched[None], n_over[None], untouched[None], tail[None]])
+
+    return close
+
+
+@functools.lru_cache(maxsize=24)
+def _close_program_delta(id_cap: int, n_fetch: int, width: int,
+                         n_over_buf: int, n_blk_buf: int, blk: int):
+    import jax
+
+    return jax.jit(make_close_delta(id_cap, n_fetch, width, n_over_buf,
+                                    n_blk_buf, blk))
+
+
+class _CloseHandle:
+    """One dispatched-but-uncollected window close (close_dispatch). The
+    accumulator/touch references are the PRE-FLIP buffers: immutable jax
+    arrays the retry loop can re-pack any number of times while the next
+    window's feeds land in the flipped twin."""
+
+    __slots__ = ("acc", "touch", "fed_total", "pending", "n_ids",
+                 "n_fetch", "width", "n_over_buf", "delta_blks", "out_dev")
+
+    def __init__(self):
+        self.acc = None
+        self.touch = None
+        self.fed_total = 0
+        self.pending = []
+        self.n_ids = 0
+        self.n_fetch = 0
+        self.width = 0
+        self.n_over_buf = 0
+        self.delta_blks = 0
+        self.out_dev = None
+
+
 def registry_content_digest(mappings, loc_address, loc_normalized,
                             loc_mapping_id, loc_is_kernel) -> bytes:
     """16-byte digest of one pid registry's full content — mappings (all
@@ -245,16 +379,28 @@ class DictAggregator:
     def __init__(self, capacity: int = 1 << 21, id_cap: int | None = None,
                  overflow: str = "sketch",
                  cm_spec: "CountMinSpec | None" = None,
-                 rotate_min_age: int = 6):
+                 rotate_min_age: int = 6,
+                 delta_fetch: bool = True,
+                 probe_backend: str = "lax"):
         from parca_agent_tpu.ops.sketch import CountMinSpec, HLLSpec
 
         if capacity & (capacity - 1):
             raise ValueError("capacity must be a power of two")
         if overflow not in ("sketch", "raise"):
             raise ValueError("overflow must be 'sketch' or 'raise'")
+        if probe_backend not in ("lax", "pallas", "auto"):
+            raise ValueError("probe_backend must be 'lax', 'pallas' or "
+                             "'auto'")
         self._cap = capacity
         self._id_cap = id_cap or capacity // 2
         self._overflow = overflow
+        # Probe implementation for the feed program: "lax" (default — the
+        # proven hot path), "pallas" (aggregator/pallas_probe.py), or
+        # "auto" (pallas when available, else lax). Resolved lazily at
+        # the first dispatch; the resolution can only downgrade pallas ->
+        # lax (never upgrade mid-run: the jit cache keys on it).
+        self._probe_backend = probe_backend
+        self._probe_resolved: str | None = None
         self._cm_spec = cm_spec or CountMinSpec()
         self._hll_spec = HLLSpec()
         self._cm = None                  # lazy [depth, width] int64
@@ -297,12 +443,37 @@ class DictAggregator:
         self._reg_version = 0
         # Device twin (created lazily; None until first window).
         self._dev = None
-        # Streaming-window state (feed/close_window protocol).
-        self._acc = None            # device int32 [id_cap] accumulator
+        # Streaming-window state (feed/close_window protocol). The
+        # accumulator (and its touched-block flags) are DOUBLE-BUFFERED:
+        # close_dispatch() flips active<->spare, so window N+1's feeds
+        # land in one buffer while window N's pack/fetch (and any
+        # grow-then-widen retry) runs against the other. The spare holds
+        # the PREVIOUS window's closed accumulator until the flip after
+        # next, strictly extending the old keep-until-next-feed retry
+        # contract.
+        self._acc = None            # active device int32 [id_cap] acc
+        self._acc_spare = None      # the other buffer (last closed window)
+        self._touch = None          # active int32 [n_blocks] touch flags
+        self._touch_spare = None
         self._fed_total = 0         # sample mass fed into the open window
         self._needs_reset = False   # first feed of next window clears acc
         self._prev_counts = None    # last closed window (width prediction)
         self._prev_n_over = 0       # last close's overflow population
+        # Delta-fetch state: block granularity (0 = tracking disabled —
+        # the id space must divide into _DELTA_BLOCK blocks), and the
+        # previous window's touched-block population (None = no history:
+        # the next close fetches full and probes the flags host-side).
+        self._blk = _DELTA_BLOCK if (
+            delta_fetch and self._id_cap % _DELTA_BLOCK == 0) else 0
+        self._n_blocks = (self._id_cap // self._blk) if self._blk else 0
+        self._prev_touched: int | None = None
+        # Deferred feed-miss settle: _feed_dispatch_async returns device
+        # handles without a host sync; the miss check settles at the NEXT
+        # feed (or at close), by which time the kernel has long finished —
+        # the capture thread stops paying the probe kernel's latency.
+        self._miss_inflight = None  # (handle, packed, snapshot, lo, h1..h3)
+        # Dispatched-but-uncollected close (close_dispatch/close_collect).
+        self._close_handle: _CloseHandle | None = None
         # Keys at probe-chain positions >= _PROBES: device lookups can
         # never find them, so feeds settle them host-side pre-ship.
         self._unreachable: dict[tuple, int] = {}
@@ -352,12 +523,32 @@ class DictAggregator:
         feed, so results are deterministic for a given snapshot."""
         if len(snapshot) == 0:
             return np.zeros(self._next_id, np.int64)
-        if self._fed_total or self._pending:
-            self._fed_total = 0
-            self._pending = []
-        self._needs_reset = True
+        self.discard_open_window()
         self.feed(snapshot, hashes)
         return self.close_window(copy=True)
+
+    def discard_open_window(self) -> None:
+        """Drop every trace of a partially-fed open window — device mass
+        (via the reset flag), host-side pending corrections, and any
+        un-settled deferred miss check — without touching the registry.
+        The swap-aware recovery entry point: the streaming feeder calls
+        this when a one-shot died mid-window or a re-probe needs a clean
+        accumulator, and it must stay correct across buffer flips."""
+        inflight, self._miss_inflight = self._miss_inflight, None
+        if inflight is not None:
+            # The dropped feed may still be EXECUTING and (on backends
+            # that zero-copy host numpy) aliasing its pack buffer: retire
+            # that buffer from the reuse pool rather than sync a device
+            # that may be the very thing being recovered from. Dropping
+            # the miss check is exact — the discarded window's new stacks
+            # were never inserted, so they simply miss again later.
+            packed = inflight[1]
+            for k, v in list(self._feed_bufs.items()):
+                if v is packed:
+                    del self._feed_bufs[k]
+        self._fed_total = 0
+        self._pending = []
+        self._needs_reset = True
 
     # -- registry identity (statics snapshot support) ------------------------
 
@@ -438,6 +629,13 @@ class DictAggregator:
         n = hi - lo
         if n <= 0:
             return
+        # Settle the PREVIOUS feed's deferred miss check first: (a) its
+        # pack buffer may be reused below and the device may alias host
+        # numpy zero-copy, (b) miss resolution (= id assignment) must
+        # stay in feed order. Between drains the kernel has long
+        # finished, so this sync is a cheap completion check, not the
+        # kernel-latency stall the old inline sync paid.
+        self._settle_misses()
         chunk_total = int(snapshot.counts[lo:hi].sum())
         if self._fed_total + chunk_total >= 2**31:
             raise ValueError("window sample total exceeds int32")
@@ -474,16 +672,39 @@ class DictAggregator:
         self._ensure_device()
         if self._acc is None:
             self._acc = self._new_acc()
+        if self._blk and self._touch is None:
+            self._touch = self._new_touch()
         t0 = _time.perf_counter()
-        miss_rel = self._feed_dispatch(packed, n_pad,
-                                       1 if self._needs_reset else 0)
+        handle = self._feed_dispatch_async(packed, n_pad,
+                                           1 if self._needs_reset else 0)
         self._needs_reset = False
         self._pending.extend(corrections)
         # _fed_total means "mass in the DEVICE accumulator" (the close
         # gate and width prediction read it); host-settled corrections
         # are not part of it.
         self._fed_total += chunk_total - sum(c for _, c in corrections)
+        # Dispatch-only cost: the miss sync that used to ride here (and
+        # block the capture thread for the kernel's full latency) is
+        # deferred to the next feed / the close, where the kernel has
+        # already completed and the sync is ~free — the feed's device
+        # work OVERLAPS capture instead of stalling it.
         self.timings["feed_dispatch"] = _time.perf_counter() - t0
+        self._miss_inflight = (handle, packed, snapshot, lo, h1, h2, h3)
+
+    def _settle_misses(self) -> None:
+        """Settle the deferred miss check of the last dispatched feed:
+        sync the miss count, and resolve any misses (insert new stacks,
+        queue host-side count corrections). Runs at the next feed and at
+        close — always before the window's counts are read."""
+        import time as _time
+
+        inflight, self._miss_inflight = self._miss_inflight, None
+        if inflight is None:
+            return
+        handle, _packed, snapshot, lo, h1, h2, h3 = inflight
+        t0 = _time.perf_counter()
+        miss_rel = self._settle_dispatch(handle)
+        self.timings["feed_settle"] = _time.perf_counter() - t0
         if len(miss_rel):
             t0 = _time.perf_counter()
             rows = miss_rel.astype(np.int64) + lo
@@ -497,30 +718,101 @@ class DictAggregator:
 
         return jnp.zeros(self._id_cap, jnp.int32)
 
-    def _feed_dispatch(self, packed: np.ndarray, n_pad: int,
-                       reset: int) -> np.ndarray:
-        """Run the feed program over the device state; returns the
-        chunk-relative miss row indices (empty in steady state). The
-        accumulator donation contract: self._acc is None while the call
-        is in flight (invalid if it throws)."""
+    def _new_touch(self):
+        """Fresh touched-block flag array (delta-fetch tracking)."""
         import jax.numpy as jnp
 
-        prog = _feed_program(self._cap, self._id_cap, n_pad)
+        return jnp.zeros(self._n_blocks, jnp.int32)
+
+    def _probe_backend_name(self) -> str:
+        if self._probe_resolved is None:
+            want = self._probe_backend
+            if want in ("auto", "pallas"):
+                from parca_agent_tpu.aggregator import pallas_probe
+
+                if pallas_probe.pallas_available():
+                    want = "pallas"
+                else:
+                    if self._probe_backend == "pallas":
+                        from parca_agent_tpu.utils.log import get_logger
+
+                        get_logger("aggregator.dict").warn(
+                            "pallas probe requested but unavailable; "
+                            "using the lax probe loop")
+                    want = "lax"
+            self._probe_resolved = want
+        return self._probe_resolved
+
+    def _feed_dispatch_async(self, packed: np.ndarray, n_pad: int,
+                             reset: int):
+        """Dispatch the feed program over the device state WITHOUT a host
+        sync; returns an opaque handle for _settle_dispatch. The
+        accumulator donation contract: self._acc/_touch are None while
+        the dispatch is in flight (invalid if it throws)."""
+        import jax.numpy as jnp
+
+        prog = _feed_program(self._cap, self._id_cap, n_pad,
+                             self._n_blocks, self._blk,
+                             self._probe_backend_name())
         acc = self._acc
-        self._acc = None  # donated: invalid if the call throws
-        acc, n_miss, miss_rows = prog(self._dev, acc, jnp.asarray(packed),
-                                      jnp.uint32(reset))
+        touch = self._touch if self._blk else jnp.zeros(1, jnp.int32)
+        self._acc = None    # donated: invalid if the call throws
+        self._touch = None
+        try:
+            acc, touch, n_miss, miss_rows = prog(
+                self._dev, acc, touch, jnp.asarray(packed),
+                jnp.uint32(reset))
+        except Exception as e:  # noqa: BLE001 - pallas path only
+            if self._probe_resolved != "pallas":
+                raise
+            # Automatic fallback, mirroring TPUAggregator.aggregate: a
+            # Pallas build/lowering failure on this backend (the CPU
+            # interpret probe can pass while Mosaic later refuses the
+            # kernel) degrades the probe to the lax loop — never a lost
+            # feed, at worst the old speed. Latched so the per-feed hot
+            # path does not retry a broken lowering. Safe to retry with
+            # the held acc/touch: a lowering failure raises at compile,
+            # before donation consumes the buffers.
+            self._probe_resolved = "lax"
+            from parca_agent_tpu.utils.log import get_logger
+
+            get_logger("aggregator.dict").warn(
+                "pallas batch probe failed; falling back to the lax "
+                "probe loop", error=repr(e)[:200])
+            prog = _feed_program(self._cap, self._id_cap, n_pad,
+                                 self._n_blocks, self._blk, "lax")
+            acc, touch, n_miss, miss_rows = prog(
+                self._dev, acc, touch, jnp.asarray(packed),
+                jnp.uint32(reset))
         self._acc = acc
-        nm = int(n_miss)  # device sync point
+        self._touch = touch if self._blk else None
+        return (n_miss, miss_rows)
+
+    def _settle_dispatch(self, handle) -> np.ndarray:
+        """Sync one dispatched feed's miss outputs; returns chunk-relative
+        miss row indices (empty in steady state)."""
+        n_miss, miss_rows = handle
+        nm = int(n_miss)  # device sync point (kernel completion)
         if not nm:
             return np.empty(0, np.int64)
         return np.asarray(miss_rows)[:nm].astype(np.int64)
 
-    def _close_fetch(self, n_fetch: int, width: int,
-                     n_over_buf: int) -> np.ndarray:
-        """Run the close pack program and fetch its packed buffer."""
+    def _close_pack_dispatch(self, acc, n_fetch: int, width: int,
+                             n_over_buf: int):
+        """Dispatch the full close pack program (no host sync)."""
         prog = _close_program(self._id_cap, n_fetch, width, n_over_buf)
-        return np.asarray(prog(self._acc))
+        return prog(acc)
+
+    def _close_pack_collect(self, out_dev) -> np.ndarray:
+        """Fetch a dispatched close pack's packed buffer."""
+        return np.asarray(out_dev)
+
+    def _close_delta_dispatch(self, acc, touch, n_fetch: int, width: int,
+                              n_over_buf: int, n_blk_buf: int):
+        """Dispatch the delta close pack program (no host sync)."""
+        prog = _close_program_delta(self._id_cap, n_fetch, width,
+                                    n_over_buf, n_blk_buf, self._blk)
+        return prog(acc, touch)
 
     def _pick_close_width(self) -> int:
         """Packing width for this close: the narrowest that provably (from
@@ -541,39 +833,162 @@ class DictAggregator:
         """Finish the open window: fetch exact int64 counts indexed by
         stack id (length == number of stacks known after this window).
 
-        The device accumulator is kept until the next window's first feed,
-        so a failed or mispredicted fetch can always be retried.
+        Internally close_dispatch() + close_collect(): the accumulator
+        flips at dispatch, so the pack/fetch (and any retry) runs against
+        the closed buffer while the next window's feeds land in the
+        other — callers that want the overlap explicitly use the split
+        API; this convenience form collects immediately.
 
         Returns an owned copy by default. copy=False returns a view into a
         double-buffered reusable allocation — valid through the NEXT close,
         overwritten by the one after; only for callers that provably finish
         with it within their own window (the bench's measured close does;
-        library consumers should take the default)."""
+        library consumers should take the default). A caller that must
+        hold the view longer transfers ownership via pin_counts()."""
+        return self.close_collect(self.close_dispatch(), copy=copy)
+
+    def close_dispatch(self) -> "_CloseHandle | None":
+        """First half of the window close: settle deferred feed misses,
+        dispatch the pack kernel against the open accumulator (no host
+        sync), and FLIP the double buffers — from here on, feeds belong
+        to the next window and land in the other accumulator while this
+        window's pack/fetch proceeds. Returns None for an empty window
+        (nothing fed, nothing pending) after counting it, matching the
+        old close_window fast path."""
         import time as _time
 
+        if self._close_handle is not None:
+            raise RuntimeError("previous close not collected")
+        self._settle_misses()
         if self._fed_total == 0 and not self._pending:
             self.stats["windows"] += 1
-            return np.zeros(self._next_id, np.int64)
-
+            # No flip, no fetch: drop the previous close's timings so a
+            # trace-span reader can't attribute them to this window.
+            self.timings.pop("buffer_flip", None)
+            self.timings.pop("delta_fetch", None)
+            return None
+        h = _CloseHandle()
+        h.pending, self._pending = self._pending, []
+        h.fed_total = self._fed_total
+        h.n_ids = self._next_id
         if self._acc is not None and self._fed_total:
+            h.acc = self._acc
+            h.touch = self._touch
             grain = 1 << 18
-            n_fetch = min(self._id_cap,
-                          max(grain, -(-self._next_id // grain) * grain))
-            width = self._pick_close_width()
+            h.n_fetch = min(self._id_cap,
+                            max(grain, -(-h.n_ids // grain) * grain))
+            h.width = self._pick_close_width()
             # Predictive sideband: cover 2x the previous window's overflow
             # population (stationary distributions keep it stable), floored
             # at _OVER_MIN; a misprediction is caught by the n_over counter
-            # and retried larger — never lossy.
+            # and retried larger — never lossy. A delta close shrinks the
+            # floor 8x (and caps at the fetched row count): the sideband
+            # would otherwise dominate the small delta buffer and erase
+            # the byte win the delta exists for.
+            h.delta_blks = self._delta_plan(h.n_fetch)
             predicted = max(_OVER_MIN, 2 * self._prev_n_over)
-            n_over_buf = min(_CLOSE_OVERS[width],
-                             1 << (predicted - 1).bit_length())
+            if h.delta_blks:
+                predicted = min(max(_OVER_MIN // 8, 2 * self._prev_n_over),
+                                h.delta_blks * self._blk)
+            h.n_over_buf = min(_CLOSE_OVERS[h.width],
+                               1 << (predicted - 1).bit_length())
+            t0 = _time.perf_counter()
+            if h.delta_blks:
+                h.out_dev = self._close_delta_dispatch(
+                    h.acc, h.touch, h.n_fetch, h.width, h.n_over_buf,
+                    h.delta_blks)
+            else:
+                h.out_dev = self._close_pack_dispatch(
+                    h.acc, h.n_fetch, h.width, h.n_over_buf)
+            self.timings["close_dispatch"] = _time.perf_counter() - t0
+        # The flip: the closed window's buffers stay intact inside the
+        # handle (retries re-pack them); the next window's first feed
+        # resets the flipped-in twin (stale by two windows) on device.
+        t0 = _time.perf_counter()
+        self._acc, self._acc_spare = self._acc_spare, self._acc
+        self._touch, self._touch_spare = self._touch_spare, self._touch
+        self._fed_total = 0
+        self._needs_reset = True
+        self.stats["buffer_flips"] = self.stats.get("buffer_flips", 0) + 1
+        self.timings["buffer_flip"] = _time.perf_counter() - t0
+        self._close_handle = h
+        return h
+
+    def _delta_plan(self, n_fetch: int) -> int:
+        """Blocks to fetch for a delta close, or 0 for a full fetch.
+        Sized predictively at 2x the previous window's touched-block
+        population (floor 8 blocks = 1k rows); delta engages only when
+        that moves less than _DELTA_MAX_FRAC of the full fetch's rows."""
+        if not self._blk or self._touch is None \
+                or self._prev_touched is None:
+            return 0
+        nb_prefix = n_fetch // self._blk
+        want = min(nb_prefix, max(8, 2 * self._prev_touched))
+        n_blk_buf = 1 << max(0, (want - 1).bit_length())
+        if n_blk_buf * self._blk > _DELTA_MAX_FRAC * n_fetch:
+            return 0
+        return n_blk_buf
+
+    def close_collect(self, handle: "_CloseHandle | None",
+                      copy: bool = True) -> np.ndarray:
+        """Second half of the window close: fetch the packed buffer
+        dispatched by close_dispatch, retrying against the handle's
+        intact (pre-flip) accumulator on any misprediction — touched
+        blocks grown first, then the full fetch as the exact fallback,
+        then the sideband's grow-then-widen ladder, all lossless."""
+        import time as _time
+
+        if handle is None:  # empty window (already counted)
+            return np.zeros(self._next_id, np.int64)
+        h = handle
+        if h is self._close_handle:
+            self._close_handle = None
+        if h.acc is not None:
+            n_fetch, width, n_over_buf = h.n_fetch, h.width, h.n_over_buf
+            n_blk_buf = h.delta_blks
+            out_dev = h.out_dev
+            h.out_dev = None
+            nb_prefix = n_fetch // self._blk if self._blk else 0
             t0 = _time.perf_counter()
             while True:
                 per32 = 32 // width
-                host = self._close_fetch(n_fetch, width, n_over_buf)
-                n_over = int(host[-2])
+                if out_dev is None:  # a retry: re-pack the intact acc
+                    if n_blk_buf:
+                        out_dev = self._close_delta_dispatch(
+                            h.acc, h.touch, n_fetch, width, n_over_buf,
+                            n_blk_buf)
+                    else:
+                        out_dev = self._close_pack_dispatch(
+                            h.acc, n_fetch, width, n_over_buf)
+                host = self._close_pack_collect(out_dev)
+                out_dev = None
                 if int(host[-1]) != 0:
                     raise AssertionError("count mass beyond fetched prefix")
+                if n_blk_buf:
+                    n_touched = int(host[-4])
+                    if int(host[-2]) != 0:
+                        # Untouched-block mass: the touch tracking missed
+                        # a write. Impossible by construction; degrade to
+                        # the exact full fetch rather than trust it.
+                        self.stats["delta_guard_trips"] = \
+                            self.stats.get("delta_guard_trips", 0) + 1
+                        n_blk_buf = 0
+                        continue
+                    if n_touched > n_blk_buf:
+                        # More blocks touched than predicted: grow to the
+                        # reported population, or fall back to the full
+                        # fetch once delta stops being a win.
+                        self.stats["delta_retries"] = \
+                            self.stats.get("delta_retries", 0) + 1
+                        need = 1 << max(0, (n_touched - 1).bit_length())
+                        if need * self._blk > _DELTA_MAX_FRAC * n_fetch:
+                            self.stats["delta_fallbacks"] = \
+                                self.stats.get("delta_fallbacks", 0) + 1
+                            n_blk_buf = 0
+                        else:
+                            n_blk_buf = need
+                        continue
+                n_over = int(host[-3] if n_blk_buf else host[-2])
                 if n_over <= n_over_buf:
                     break
                 # Sideband overran: acc is intact, retry. Grow the buffer
@@ -592,17 +1007,32 @@ class DictAggregator:
                     width = 8 if width == 4 else 16
                     n_over_buf = _CLOSE_OVERS[width]
             self._prev_n_over = n_over
-            self.timings["close_fetch"] = _time.perf_counter() - t0
+            fetch_s = _time.perf_counter() - t0
+            self.timings["close_fetch"] = fetch_s
+            if n_blk_buf:
+                self.timings["delta_fetch"] = fetch_s
+            else:
+                # A full close must not leave the previous DELTA close's
+                # timing behind: the profiler records a delta_fetch trace
+                # span only when the key is present for THIS window.
+                self.timings.pop("delta_fetch", None)
             t0 = _time.perf_counter()
-            lanes_n = n_fetch // per32
-            lanes = host[:lanes_n]
             sentinel = (1 << width) - 1
             shifts = (np.arange(per32, dtype=np.uint32) * width)[None, :]
-            wb = self._unpack_bufs.get((n_fetch, width))
+            if n_blk_buf:
+                lanes_n = n_blk_buf * self._blk // per32
+                wb_key = (1, n_blk_buf * self._blk, width)
+            else:
+                lanes_n = n_fetch // per32
+                wb_key = (0, n_fetch, width)
+            lanes = host[:lanes_n]
+            wb = self._unpack_bufs.get(wb_key)
             if wb is None:
                 if len(self._unpack_bufs) >= 4:  # bounded: evict smallest
-                    self._unpack_bufs.pop(min(self._unpack_bufs))
-                wb = self._unpack_bufs[(n_fetch, width)] = np.empty(
+                    self._unpack_bufs.pop(
+                        min(self._unpack_bufs,
+                            key=lambda k: self._unpack_bufs[k].nbytes))
+                wb = self._unpack_bufs[wb_key] = np.empty(
                     (lanes_n, per32), np.uint32)
             np.right_shift(lanes[:, None], shifts, out=wb)
             np.bitwise_and(wb, np.uint32(sentinel), out=wb)
@@ -611,26 +1041,75 @@ class DictAggregator:
             if counts is None or len(counts) != n_fetch:
                 counts = np.empty(n_fetch, np.int64)
                 self._counts_bufs[self._counts_flip] = counts
-            counts[:] = wb.reshape(-1)
-            over_id = host[lanes_n:lanes_n + n_over]
-            over_val = host[lanes_n + n_over_buf:lanes_n + n_over_buf + n_over]
+            if n_blk_buf:
+                # Delta unpack: zero, then scatter the touched blocks
+                # back to their id ranges (block ids ride the buffer).
+                counts[:] = 0
+                n_t = n_touched
+                bids = host[lanes_n:lanes_n + n_blk_buf][:n_t].astype(
+                    np.int64)
+                idx = (bids[:, None] * self._blk
+                       + np.arange(self._blk, dtype=np.int64)).reshape(-1)
+                counts[idx] = wb.reshape(-1)[: n_t * self._blk]
+                over_off = lanes_n + n_blk_buf
+                self._prev_touched = n_t
+                self.stats["delta_closes"] = \
+                    self.stats.get("delta_closes", 0) + 1
+                self.stats["fetch_rows_last"] = n_t * self._blk
+            else:
+                counts[:] = wb.reshape(-1)
+                over_off = lanes_n
+                self.stats["full_closes"] = \
+                    self.stats.get("full_closes", 0) + 1
+                self.stats["fetch_rows_last"] = n_fetch
+                if self._blk and h.touch is not None:
+                    # Learn the touched population from the flags (one
+                    # small fetch) so the NEXT close can go delta — full
+                    # closes are the cold path, so the extra round trip
+                    # amortizes away in steady state.
+                    try:
+                        self._prev_touched = int(
+                            (np.asarray(h.touch)[:nb_prefix] > 0).sum())
+                    except Exception:  # noqa: BLE001 - advisory only
+                        self._prev_touched = None
+            over_id = host[over_off:over_off + n_over]
+            over_val = host[over_off + n_over_buf:
+                            over_off + n_over_buf + n_over]
             counts[over_id] = over_val
+            self.stats["fetch_bytes_last"] = int(host.nbytes)
+            self.stats["fetch_bytes_total"] = \
+                self.stats.get("fetch_bytes_total", 0) + int(host.nbytes)
             self.timings["close_unpack"] = _time.perf_counter() - t0
         else:
-            counts = np.zeros(max(self._next_id, 1), np.int64)
+            # Pending-only close (nothing fed to the device): no fetch
+            # ran, so the previous close's delta timing must not survive
+            # into this window's trace spans.
+            self.timings.pop("delta_fetch", None)
+            counts = np.zeros(max(h.n_ids, 1), np.int64)
 
-        if self._pending:
-            sids = np.array([p[0] for p in self._pending], np.int64)
-            cnts = np.array([p[1] for p in self._pending], np.int64)
+        if h.pending:
+            sids = np.array([p[0] for p in h.pending], np.int64)
+            cnts = np.array([p[1] for p in h.pending], np.int64)
             np.add.at(counts, sids, cnts)
-            self._pending = []
-        self._fed_total = 0
-        self._needs_reset = True
+            h.pending = []
         self.stats["windows"] += 1
-        out = counts[: self._next_id]
+        out = counts[: h.n_ids]
         self._last_seen[np.flatnonzero(out)] = self.stats["windows"]
         self._prev_counts = out
         return out.copy() if copy else out
+
+    def pin_counts(self, counts: np.ndarray) -> None:
+        """Copy-on-hand-off for the double-buffered close counts: a
+        caller that must read a copy=False close result past its
+        one-close validity window (the encode pipeline holding a window
+        across a slow worker, tests) transfers ownership — the backing
+        buffer leaves the reuse rotation, so the close after next
+        allocates fresh instead of overwriting it. Zero-copy: ownership
+        moves, bytes don't."""
+        base = counts.base if counts.base is not None else counts
+        for i, b in enumerate(self._counts_bufs):
+            if b is base or b is counts:
+                self._counts_bufs[i] = None
 
     # -- bounded-memory degradation ------------------------------------------
 
@@ -684,6 +1163,12 @@ class DictAggregator:
         id."""
         if not self._rotate_pending:
             return
+        if self._close_handle is not None or self._miss_inflight is not None:
+            # An uncollected close still references the pre-flip device
+            # buffers (its fetched counts are indexed by the CURRENT id
+            # space), and an unsettled feed may still insert: rotation
+            # would remap ids under both. Defer to the next boundary.
+            return
         self._rotate_pending = False
         w = self.stats["windows"]
         n = self._next_id
@@ -729,8 +1214,14 @@ class DictAggregator:
         self._pids = {p: r for p, r in self._pids.items() if p in live_pids}
         # Device twin is rebuilt lazily from the host mirror; the open
         # accumulator is empty at a boundary; width prediction resets.
+        # BOTH double buffers go (the spare indexes the old id space too),
+        # as do the touch flags and the delta history.
         self._dev = None
         self._acc = None
+        self._acc_spare = None
+        self._touch = None
+        self._touch_spare = None
+        self._prev_touched = None
         self._prev_counts = None
         self._prev_n_over = 0  # sideband prediction resets with it
         self._reg_version += 1

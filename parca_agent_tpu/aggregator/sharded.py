@@ -155,6 +155,14 @@ class ShardedDictAggregator(DictAggregator):
         self._cap_s = cap_s
         self._part_bufs: dict[int, np.ndarray] = {}  # n_pad_s -> buffer
         super().__init__(capacity=capacity, id_cap=id_cap, **kw)
+        # Delta-fetch touch tracking is single-chip for now: the sharded
+        # close psums partial accumulators across the mesh and fetches
+        # the packed full prefix once; its feed program carries no touch
+        # flags. Double-buffering (the flip) inherits unchanged.
+        self._blk = 0
+        self._n_blocks = 0
+        self._touch = None
+        self._touch_spare = None
 
     # -- host-mirror placement: probe within the key's home sub-table -------
 
@@ -285,8 +293,8 @@ class ShardedDictAggregator(DictAggregator):
             out[s, 4, : len(mine)] = mine.astype(np.uint32)
         return out
 
-    def _feed_dispatch(self, packed: np.ndarray, n_pad: int,
-                       reset: int) -> np.ndarray:
+    def _feed_dispatch_async(self, packed: np.ndarray, n_pad: int,
+                             reset: int):
         import jax
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
@@ -301,7 +309,11 @@ class ShardedDictAggregator(DictAggregator):
         acc, n_miss, miss_rows = prog(self._dev, acc, dev_packed,
                                       np.uint32(reset))
         self._acc = acc
-        per_shard = np.asarray(n_miss)
+        return (n_miss, miss_rows)
+
+    def _settle_dispatch(self, handle) -> np.ndarray:
+        n_miss, miss_rows = handle
+        per_shard = np.asarray(n_miss)  # device sync point
         if not per_shard.any():
             return np.empty(0, np.int64)
         # Each row has exactly one home shard, so the per-shard miss lists
@@ -311,13 +323,15 @@ class ShardedDictAggregator(DictAggregator):
             rows_all[s, : int(k)] for s, k in enumerate(per_shard) if k
         ]).astype(np.int64)
 
-    def _close_fetch(self, n_fetch: int, width: int,
-                     n_over_buf: int) -> np.ndarray:
+    def _close_pack_dispatch(self, acc, n_fetch: int, width: int,
+                             n_over_buf: int):
         prog = _sharded_close_program(self._mesh, self._n_shards,
                                       self._id_cap, n_fetch, width,
                                       n_over_buf)
-        out = prog(self._acc)
-        return np.asarray(out[0])  # every shard holds the same packed copy
+        return prog(acc)[0]  # every shard holds the same packed copy
+
+    def _close_pack_collect(self, out_dev) -> np.ndarray:
+        return np.asarray(out_dev)
 
     def _dev_scatter(self, slots: np.ndarray, vals: np.ndarray) -> None:
         import jax.numpy as jnp

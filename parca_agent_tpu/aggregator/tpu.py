@@ -66,12 +66,14 @@ def _lex_le3(a1, a2, a3, b1, b2, b3):
     return (a1 < b1) | ((a1 == b1) & ((a2 < b2) | ((a2 == b2) & (a3 <= b3))))
 
 
-@functools.lru_cache(maxsize=1)
+@functools.lru_cache(maxsize=4)
 def _jitted_kernel():
     import jax
 
     return jax.jit(
-        _window_kernel, static_argnames=("n_pad", "l_cap", "m_pad", "f_cap")
+        _window_kernel,
+        static_argnames=("n_pad", "l_cap", "m_pad", "f_cap", "hash_locs",
+                         "interpret"),
     )
 
 
@@ -93,6 +95,8 @@ def _window_kernel(
     l_cap: int,
     m_pad: int,
     f_cap: int,
+    hash_locs: bool = False,
+    interpret: bool = True,
 ):
     import jax
     import jax.numpy as jnp
@@ -171,55 +175,118 @@ def _window_kernel(
     fsrc = jnp.full((f_cap,), n * s, jnp.int32).at[tgt].set(
         jnp.arange(n * s, dtype=jnp.int32), mode="drop")
 
-    fpid_s, fhi_s, flo_s, fidx = jax.lax.sort(
-        (fpid, fhi, flo, fsrc),
-        num_keys=3,
-        is_stable=True,
-    )
-    # Liveness is derivable (dead f_cap slots carry the U32_MAX fill pid,
-    # real pids are int32-ranged), so it does not ride the sort — the
-    # f_cap-lane bitonic sort is this kernel's dominant cost and every
-    # dropped array is ~20% of its traffic.
-    flive_s = fpid_s != jnp.uint32(_U32_MAX)
+    if hash_locs:
+        # Hash-table location dedup (the sub-RTT close PR): every live
+        # frame's raw 96-bit (pid, hi, lo) key probes/claims an
+        # open-addressing table (the Pallas batch-probe kernel,
+        # aggregator/pallas_probe.py) instead of riding the f_cap-lane
+        # bitonic sort — the stateless kernel's dominant cost. The sort
+        # that remains runs over the cap_loc TABLE entries (~2x unique
+        # locations), restoring the sort path's exact output order, so
+        # the pprof bytes are identical. Identity is the full key —
+        # a probe-base hash collision only lengthens a chain.
+        from parca_agent_tpu.aggregator.pallas_probe import (
+            make_loc_table_builder,
+        )
 
-    same_loc = (
-        (fpid_s == _shift_down(fpid_s, jnp.uint32(_U32_MAX)))
-        & (fhi_s == _shift_down(fhi_s, jnp.uint32(0)))
-        & (flo_s == _shift_down(flo_s, jnp.uint32(0)))
-    )
-    same_loc = same_loc.at[0].set(False)
-    new_loc = (~same_loc) & flive_s
-    new_loc = new_loc.at[0].set(flive_s[0])
-    n_locs = new_loc.astype(jnp.int32).sum()
+        cap_loc = 2 * l_cap  # load factor <= 0.5 once l_cap fits n_locs
+        base = multilinear_hash_u32(
+            jnp.stack([fpid, fhi, flo], axis=-1), 3)
+        builder = make_loc_table_builder(f_cap, cap_loc,
+                                         interpret=interpret)
+        slot, tpid_t, thi_t, tlo_t = builder(fpid, fhi, flo, base)
+        flive = fpid != jnp.uint32(_U32_MAX)
+        # A live frame that could not place means the table is full
+        # (l_cap undersized): report n_locs = l_cap + 1 so the caller's
+        # existing doubling retry fires — same contract as the sort
+        # path's overflow.
+        overflowed = (flive & (slot < 0)).any()
+        tslot = jnp.arange(cap_loc, dtype=jnp.int32)
+        spid, shi2, slo2, sslot = jax.lax.sort(
+            (tpid_t, thi_t, tlo_t, tslot), num_keys=3, is_stable=True)
+        tlive = spid != jnp.uint32(_U32_MAX)
+        n_locs = jnp.where(overflowed, jnp.int32(l_cap + 1),
+                           tlive.astype(jnp.int32).sum())
+        loc_seq = jnp.cumsum(tlive.astype(jnp.int32))
+        new_pid = (spid != _shift_down(spid, jnp.uint32(_U32_MAX))) & tlive
+        new_pid = new_pid.at[0].set(tlive[0])
+        pid_seg = jnp.maximum(jnp.cumsum(new_pid.astype(jnp.int32)) - 1, 0)
+        pid_first_seq = jax.ops.segment_min(
+            jnp.where(tlive, loc_seq, jnp.int32(2**31 - 1)),
+            pid_seg,
+            num_segments=cap_loc,
+        )
+        rank_sorted = jnp.where(tlive, loc_seq - pid_first_seq[pid_seg] + 1,
+                                0)
+        # slot -> per-pid rank (sslot is a permutation of the table), then
+        # frame -> rank via each frame's claimed slot.
+        rank_by_slot = jnp.zeros((cap_loc,), jnp.int32).at[sslot].set(
+            rank_sorted)
+        frame_rank = jnp.where(slot >= 0,
+                               rank_by_slot[jnp.maximum(slot, 0)], 0)
+        loc_ids = (
+            jnp.zeros((n * s,), jnp.int32).at[fsrc].set(
+                frame_rank, mode="drop").reshape(n, s)
+        )
+        # Sorted live prefix == the sort path's compacted table (dead
+        # entries: pid U32_MAX, hi/lo 0 — identical fills).
+        loc_pid = spid[:l_cap]
+        loc_hi = shi2[:l_cap]
+        loc_lo = slo2[:l_cap]
+    else:
+        fpid_s, fhi_s, flo_s, fidx = jax.lax.sort(
+            (fpid, fhi, flo, fsrc),
+            num_keys=3,
+            is_stable=True,
+        )
+        # Liveness is derivable (dead f_cap slots carry the U32_MAX fill
+        # pid, real pids are int32-ranged), so it does not ride the sort —
+        # the f_cap-lane bitonic sort is this kernel's dominant cost and
+        # every dropped array is ~20% of its traffic.
+        flive_s = fpid_s != jnp.uint32(_U32_MAX)
 
-    # Global 1-based location sequence number, constant within a loc group.
-    loc_seq = jnp.cumsum(new_loc.astype(jnp.int32))
+        same_loc = (
+            (fpid_s == _shift_down(fpid_s, jnp.uint32(_U32_MAX)))
+            & (fhi_s == _shift_down(fhi_s, jnp.uint32(0)))
+            & (flo_s == _shift_down(flo_s, jnp.uint32(0)))
+        )
+        same_loc = same_loc.at[0].set(False)
+        new_loc = (~same_loc) & flive_s
+        new_loc = new_loc.at[0].set(flive_s[0])
+        n_locs = new_loc.astype(jnp.int32).sum()
 
-    # First loc sequence number within each pid segment -> per-pid rank.
-    new_pid = (fpid_s != _shift_down(fpid_s, jnp.uint32(_U32_MAX))) & flive_s
-    new_pid = new_pid.at[0].set(flive_s[0])
-    pid_seg = jnp.maximum(jnp.cumsum(new_pid.astype(jnp.int32)) - 1, 0)
-    pid_first_seq = jax.ops.segment_min(
-        jnp.where(flive_s, loc_seq, jnp.int32(2**31 - 1)),
-        pid_seg,
-        num_segments=n_pad,
-    )
-    rank = jnp.where(flive_s, loc_seq - pid_first_seq[pid_seg] + 1, 0)
+        # Global 1-based location sequence number, constant within a group.
+        loc_seq = jnp.cumsum(new_loc.astype(jnp.int32))
 
-    # Scatter per-frame ranks back to representative-row layout [N, S]
-    # (padding entries carry fidx == n*s and drop out).
-    loc_ids = (
-        jnp.zeros((n * s,), jnp.int32).at[fidx].set(rank, mode="drop")
-        .reshape(n, s)
-    )
+        # First loc sequence number within each pid segment -> per-pid rank.
+        new_pid = (fpid_s != _shift_down(fpid_s, jnp.uint32(_U32_MAX))) \
+            & flive_s
+        new_pid = new_pid.at[0].set(flive_s[0])
+        pid_seg = jnp.maximum(jnp.cumsum(new_pid.astype(jnp.int32)) - 1, 0)
+        pid_first_seq = jax.ops.segment_min(
+            jnp.where(flive_s, loc_seq, jnp.int32(2**31 - 1)),
+            pid_seg,
+            num_segments=n_pad,
+        )
+        rank = jnp.where(flive_s, loc_seq - pid_first_seq[pid_seg] + 1, 0)
 
-    # Compact the unique locations into the bounded [L_cap] table.
-    tgt = jnp.where(new_loc, loc_seq - 1, jnp.int32(l_cap))
-    loc_pid = (
-        jnp.full((l_cap,), _U32_MAX, jnp.uint32).at[tgt].set(fpid_s, mode="drop")
-    )
-    loc_hi = jnp.zeros((l_cap,), jnp.uint32).at[tgt].set(fhi_s, mode="drop")
-    loc_lo = jnp.zeros((l_cap,), jnp.uint32).at[tgt].set(flo_s, mode="drop")
+        # Scatter per-frame ranks back to representative-row layout [N, S]
+        # (padding entries carry fidx == n*s and drop out).
+        loc_ids = (
+            jnp.zeros((n * s,), jnp.int32).at[fidx].set(rank, mode="drop")
+            .reshape(n, s)
+        )
+
+        # Compact the unique locations into the bounded [L_cap] table.
+        tgt = jnp.where(new_loc, loc_seq - 1, jnp.int32(l_cap))
+        loc_pid = (
+            jnp.full((l_cap,), _U32_MAX, jnp.uint32).at[tgt].set(
+                fpid_s, mode="drop")
+        )
+        loc_hi = jnp.zeros((l_cap,), jnp.uint32).at[tgt].set(fhi_s,
+                                                             mode="drop")
+        loc_lo = jnp.zeros((l_cap,), jnp.uint32).at[tgt].set(flo_s,
+                                                             mode="drop")
 
     # ---- 4. mapping join --------------------------------------------------
     # rank_le[q] = number of mapping rows with key <= (pid, addr); candidate
@@ -373,6 +440,16 @@ class TPUAggregator:
 
     name: str = "tpu"
 
+    # Location dedup implementation: "hash" re-expresses the dominant
+    # f_cap-lane sort as a hash-table build+probe (the Pallas kernel,
+    # aggregator/pallas_probe.py — the full-rebuild/backfill fix, docs/
+    # perf.md "sub-RTT close"); "sort" is the proven lax pipeline;
+    # "auto" (default) uses hash when Pallas is available and falls back
+    # to sort automatically — including at runtime if the hash kernel
+    # fails to build/lower on this backend. Output bytes are identical
+    # either way (enforced by tests and the bench's close_overlap phase).
+    dedup: str = "auto"
+
     # Unique-location count beyond which the one-shot kernel is the wrong
     # tool (the location dedup sort dominates: ~45 s at the adversarial
     # 26.5 M-location synthetic, docs/perf.md) and the streaming dict
@@ -380,6 +457,23 @@ class TPUAggregator:
     # exact either way.
     LOC_WARN_THRESHOLD = 1 << 22
     _loc_warned: bool = False
+    _hash_disabled: bool = False
+
+    def _use_hash(self) -> bool:
+        if self._hash_disabled or self.dedup == "sort":
+            return False
+        from parca_agent_tpu.aggregator.pallas_probe import pallas_available
+
+        if pallas_available():
+            return True
+        if self.dedup == "hash":
+            from parca_agent_tpu.utils.log import get_logger
+
+            get_logger("aggregator.tpu").warn(
+                "hash dedup requested but Pallas is unavailable; using "
+                "the lax sort kernel")
+        self._hash_disabled = True
+        return False
 
     def aggregate(self, snapshot: WindowSnapshot) -> list[PidProfile]:
         import jax.numpy as jnp
@@ -390,9 +484,32 @@ class TPUAggregator:
         table = snapshot.mappings
         host_args, dims = pack_window_inputs(snapshot)
         dev_args = tuple(jnp.asarray(a) for a in host_args)
+        use_hash = self._use_hash()
 
         while True:
-            out = _jitted_kernel()(*dev_args, **dims)
+            try:
+                from parca_agent_tpu.aggregator.pallas_probe import (
+                    default_interpret,
+                )
+
+                out = _jitted_kernel()(*dev_args, hash_locs=use_hash,
+                                       interpret=default_interpret(),
+                                       **dims)
+            except Exception as e:  # noqa: BLE001 - hash path only
+                if not use_hash:
+                    raise
+                # Automatic fallback: a Pallas build/lowering failure on
+                # this backend degrades to the lax sort kernel — never a
+                # lost window, at worst the old speed. Latched so the
+                # per-window hot path does not retry a broken lowering.
+                self._hash_disabled = True
+                use_hash = False
+                from parca_agent_tpu.utils.log import get_logger
+
+                get_logger("aggregator.tpu").warn(
+                    "hash location dedup failed; falling back to the lax "
+                    "sort kernel", error=repr(e)[:200])
+                continue
             (n_groups, n_locs, out_pid, depth, values, loc_ids,
              loc_pid, loc_hi, loc_lo, loc_map_row) = map(np.asarray, out)
             if int(n_locs) <= dims["l_cap"]:

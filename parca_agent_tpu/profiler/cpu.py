@@ -658,10 +658,30 @@ class CPUProfiler:
             fed = self._feeder.stats.get("last_window_feed_s", 0.0)
             if fed:
                 tr.add_span("feed", fed)
+            # The double-buffer overlap split (docs/perf.md "sub-RTT
+            # close"): capture-thread seconds spent DISPATCHING feeds —
+            # work whose device execution overlaps capture instead of
+            # stalling it. The deferred settle residue is feed minus
+            # this span; the overlap is visible in /debug/windows.
+            disp = self._feeder.stats.get("last_window_dispatch_s", 0.0)
+            if disp:
+                tr.add_span("feed_dispatch_overlap", disp)
             if self._feeder.stats.get("last_window_streamed", 0):
                 tr.add_span("fetch",
                             self._feeder.stats.get("last_close_s", 0.0))
         if kind == "counts":
+            # Buffer-flip and delta-fetch spans come from the close that
+            # just ran (streamed or one-shot): the aggregator's timings
+            # dict carries buffer_flip on every double-buffered close and
+            # delta_fetch only when THIS close fetched touched blocks
+            # instead of the full prefix (dict.py close_collect).
+            tim = getattr(self._aggregator, "timings", None) or {}
+            flip = tim.get("buffer_flip", 0.0)
+            if flip:
+                tr.add_span("buffer_flip", flip)
+            delta = tim.get("delta_fetch", 0.0)
+            if delta:
+                tr.add_span("delta_fetch", delta)
             n_piped = self._submit_to_pipeline(out, snapshot, tr)
             if n_piped is not None:
                 self.metrics.samples_aggregated += snapshot.total_samples()

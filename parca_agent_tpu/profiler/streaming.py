@@ -129,8 +129,33 @@ class StreamingWindowFeeder:
                       # fallback window must not re-record a stale
                       # last_close_s).
                       "last_window_feed_s": 0.0,
-                      "last_window_streamed": 0}
+                      "last_window_streamed": 0,
+                      # Double-buffer overlap accounting (docs/perf.md
+                      # "sub-RTT close"): per-window capture-thread
+                      # seconds spent DISPATCHING feeds (the device work
+                      # overlaps capture) vs SETTLING the deferred miss
+                      # checks (the residual wait, ~a completion check
+                      # between drains).
+                      "last_window_dispatch_s": 0.0,
+                      "last_window_settle_s": 0.0}
         self._window_feed_s = 0.0
+        self._window_dispatch_s = 0.0
+        self._window_settle_s = 0.0
+
+    def _discard_open_window(self) -> None:
+        """Drop the aggregator's open-window state across buffer flips:
+        fed device mass, host pending corrections, and (on swap-aware
+        aggregators) any deferred feed-miss check — dropping those too is
+        what keeps recovery exact under double-buffering, since a stale
+        miss check settling into a NEW window would inject the discarded
+        window's corrections."""
+        discard = getattr(self._agg, "discard_open_window", None)
+        if discard is not None:
+            discard()
+            return
+        self._agg._fed_total = 0
+        self._agg._pending = []
+        self._agg._needs_reset = True
 
     def attach_encoder(self, encoder, prebuild=None) -> None:
         """Wire the profiler's WindowEncoder for statics amortization.
@@ -201,6 +226,16 @@ class StreamingWindowFeeder:
                                        table, 0, 0, weights=counts)
             if len(mini) == 0:
                 return
+            if self._fed_total == 0:
+                # First feed of a new window: a one-shot fallback window
+                # ran window_counts() on this same aggregator between the
+                # boundary and now, leaving ITS feed_dispatch/feed_settle
+                # timings behind — discard them so the pop below can't
+                # credit them to this window's overlap accounting.
+                tim = getattr(self._agg, "timings", None)
+                if tim is not None:
+                    tim.pop("feed_dispatch", None)
+                    tim.pop("feed_settle", None)
             if self._fed_total == 0 \
                     and (getattr(self._agg, "_fed_total", 0)
                          or getattr(self._agg, "_pending", None)):
@@ -214,9 +249,7 @@ class StreamingWindowFeeder:
                 # inflate counts past the feeder's own fed-mass gate
                 # ("_pending" survives an acc reset: the flag only zeroes
                 # the device accumulator).
-                self._agg._fed_total = 0
-                self._agg._pending = []
-                self._agg._needs_reset = True
+                self._discard_open_window()
             if not self._feed_guarded(mini):
                 # Do NOT try again this window: a wedged device would
                 # stall the capture loop on every subsequent drain.
@@ -224,6 +257,16 @@ class StreamingWindowFeeder:
                 # capped-exponential cooldown.
                 self._enter_cooldown("streaming feed failed")
                 return
+            # Split the feed's capture-thread cost into dispatch (launch
+            # the probe kernel; its device execution overlaps capture)
+            # and settle (the PREVIOUS feed's deferred miss check — by
+            # now a completion check, not a kernel wait). Popped, not
+            # read: feed_settle is only written when an inflight check
+            # existed, and a stale value must not re-count.
+            tim = getattr(self._agg, "timings", None)
+            if tim is not None:
+                self._window_dispatch_s += tim.pop("feed_dispatch", 0.0)
+                self._window_settle_s += tim.pop("feed_settle", 0.0)
             self._fed_total += mini.total_samples()
             self.stats["drains_fed"] += 1
             if self._encoder is not None and self._prebuild_period:
@@ -283,6 +326,10 @@ class StreamingWindowFeeder:
         self._fed_total = 0
         self.stats["last_window_feed_s"] = self._window_feed_s
         self._window_feed_s = 0.0
+        self.stats["last_window_dispatch_s"] = self._window_dispatch_s
+        self._window_dispatch_s = 0.0
+        self.stats["last_window_settle_s"] = self._window_settle_s
+        self._window_settle_s = 0.0
         self.stats["last_window_streamed"] = 0
         if snapshot.period_ns:
             self._prebuild_period = snapshot.period_ns
@@ -298,22 +345,36 @@ class StreamingWindowFeeder:
                 # The device accumulator may hold residual mass from a
                 # one-shot window_counts that failed AFTER its feed
                 # dispatched (close raised -> CPU fallback, _needs_reset
-                # left False). Force a reset so the first streamed feed
-                # starts from a clean accumulator.
-                self._agg._needs_reset = True
+                # left False), plus host-pending corrections and a
+                # deferred miss check from that feed. Discard all of it
+                # so the first streamed feed starts from a clean window.
+                self._discard_open_window()
                 _log.info("streaming feeder re-enabled; probing next "
                           "window")
             return None
         if fed != snapshot.total_samples():
             # A drain raced the window boundary or a tee was skipped:
-            # exactness rules, stream the next window instead.
+            # exactness rules, stream the next window instead. Discard
+            # the whole partial window — including any deferred miss
+            # check, which would otherwise settle its corrections into
+            # the NEXT window.
             self.stats["windows_fallback"] += 1
-            self._agg._needs_reset = True  # discard the partial window
+            self._discard_open_window()
             return None
         t0 = time.perf_counter()
         counts = self._agg.close_window(copy=False)
         self.stats["windows_streamed"] += 1
         self.stats["last_window_streamed"] = 1
         self.stats["last_close_s"] = time.perf_counter() - t0
+        # The close settled the window's final feed (and paid its
+        # dispatch bookkeeping) AFTER the boundary reset above — pop the
+        # timings into the window that just closed, or they'd leak into
+        # the next window's first drain.
+        tim = getattr(self._agg, "timings", None)
+        if tim is not None:
+            self.stats["last_window_dispatch_s"] += tim.pop(
+                "feed_dispatch", 0.0)
+            self.stats["last_window_settle_s"] += tim.pop(
+                "feed_settle", 0.0)
         self._backoff = self._backoff_base  # healthy again: reset backoff
         return counts

@@ -177,6 +177,29 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
             for k, v in pipe.stats.items():
                 emit(f"parca_agent_encode_pipeline_{k}",
                      round(v, 6) if isinstance(v, float) else v, lab)
+        agg_stats = getattr(getattr(p, "_aggregator", None), "stats", None)
+        if isinstance(agg_stats, dict) and "windows" in agg_stats:
+            # Sub-RTT close observability (docs/perf.md "sub-RTT close"):
+            # what the LAST window close actually fetched (delta closes
+            # move only touched-block rows; full closes move the whole
+            # n_fetch prefix) plus the flip/delta/retry counters that
+            # show which close path windows are riding.
+            emit("parca_agent_close_fetch_rows",
+                 agg_stats.get("fetch_rows_last", 0), lab)
+            emit("parca_agent_close_fetch_bytes",
+                 agg_stats.get("fetch_bytes_last", 0), lab)
+            emit("parca_agent_close_fetch_bytes_total",
+                 agg_stats.get("fetch_bytes_total", 0), lab)
+            emit("parca_agent_close_buffer_flips_total",
+                 agg_stats.get("buffer_flips", 0), lab)
+            emit("parca_agent_close_delta_closes_total",
+                 agg_stats.get("delta_closes", 0), lab)
+            emit("parca_agent_close_full_closes_total",
+                 agg_stats.get("full_closes", 0), lab)
+            emit("parca_agent_close_delta_retries_total",
+                 agg_stats.get("delta_retries", 0), lab)
+            emit("parca_agent_close_delta_fallbacks_total",
+                 agg_stats.get("delta_fallbacks", 0), lab)
         enc = getattr(p, "_encoder", None)
         if enc is not None and getattr(enc, "stats", None):
             # Template dead rows: count-0 samples shipped (wire-size

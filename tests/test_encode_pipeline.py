@@ -458,10 +458,20 @@ def test_streaming_feeder_routes_prebuild_through_pipeline():
 
     feeder.attach_encoder(enc, prebuild=request_prebuild)
     n = len(snap)
-    feeder.on_drain((snap.pids[:n], snap.tids[:n], snap.user_len[:n],
-                     snap.kernel_len[:n], snap.stacks[:n],
-                     snap.counts[:n]))
+    mid = n // 2
+    feeder.on_drain((snap.pids[:mid], snap.tids[:mid], snap.user_len[:mid],
+                     snap.kernel_len[:mid], snap.stacks[:mid],
+                     snap.counts[:mid]))
     assert feeder.stats["drains_fed"] == 1
     assert feeder.stats["statics_prebuilt"] == 1
     assert len(calls) == 1        # enqueued, not built inline
+    # Feed registration is deferred by one drain (the sub-RTT close's
+    # async dispatch settles the previous feed's miss check at the NEXT
+    # feed, docs/perf.md "sub-RTT close"): the second drain makes the
+    # first drain's pids visible to the backlog.
+    feeder.on_drain((snap.pids[mid:n], snap.tids[mid:n],
+                     snap.user_len[mid:n], snap.kernel_len[mid:n],
+                     snap.stacks[mid:n], snap.counts[mid:n]))
+    assert feeder.stats["drains_fed"] == 2
+    assert len(calls) == 2
     assert enc.statics_backlog(feeder._prebuild_period) > 0

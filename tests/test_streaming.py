@@ -73,6 +73,31 @@ def test_feeder_incomplete_window_falls_back():
     assert feeder.take_window_if_complete(snap) is not None
 
 
+def test_fallback_window_timings_do_not_leak_into_next_stream():
+    """A one-shot window_counts between two streamed windows writes its
+    own feed_dispatch/feed_settle into the shared aggregator's timings;
+    the next streamed window must not pop them into ITS overlap stats."""
+    snap = _snap(seed=9)
+    agg = DictAggregator(capacity=1 << 11)
+    feeder = StreamingWindowFeeder(agg, FakeMaps(), FakeObjs())
+    feeder.on_drain(_cols(snap, 0, len(snap) // 2))  # half: falls back
+    assert feeder.take_window_if_complete(snap) is None
+    agg.window_counts(snap)  # the one-shot fallback window
+    assert "feed_dispatch" in agg.timings  # the leak source exists
+    # Sentinel values a leak would make unmissable in the next stats.
+    agg.timings["feed_dispatch"] = 999.0
+    agg.timings["feed_settle"] = 999.0
+    for lo in range(0, len(snap), 128):
+        feeder.on_drain(_cols(snap, lo, min(lo + 128, len(snap))))
+    assert feeder.take_window_if_complete(snap) is not None
+    assert feeder.stats["last_window_dispatch_s"] < 100.0
+    assert feeder.stats["last_window_settle_s"] < 100.0
+    # The pop sites consumed every settle/dispatch timing: nothing left
+    # for the NEXT window's first drain to mis-attribute.
+    assert "feed_dispatch" not in agg.timings
+    assert "feed_settle" not in agg.timings
+
+
 def test_feeder_disables_on_feed_failure():
     snap = _snap(seed=3)
 
