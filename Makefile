@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-live chaos fuzz bench bench-statics bench-close trace-smoke fixtures golden clean install
+.PHONY: all native test test-live chaos fuzz bench bench-statics bench-close bench-hotspot trace-smoke hotspot-smoke fixtures golden clean install
 
 all: native
 
@@ -26,7 +26,7 @@ test-live:
 # outages, disk-full spill, actor crashes, device/fleet hangs —
 # deterministic by design, so it also rides every unmarked run.
 chaos:
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the
@@ -59,6 +59,21 @@ bench-close:
 # zero windows lost. Host-bound, so it pins the cpu backend.
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m parca_agent_tpu.tools.trace_smoke
+
+# Hotspot rollup acceptance drill (docs/hotspots.md): a multi-hour
+# simulated window stream folded into the rollup hierarchy; top-K vs
+# the exact aggregate >= 99%, query p50/p99 at dashboard rates, and the
+# per-level byte caps held with oldest-eviction engaged. Numpy-only.
+bench-hotspot:
+	JAX_PLATFORMS=cpu PARCA_BENCH_HOTSPOT_CHILD=1 $(PYTHON) bench.py
+
+# Hotspot end-to-end smoke (docs/hotspots.md): a short real profiler
+# session (dict aggregator, encode pipeline) must serve human-readable
+# top-K answers on /hotspots, reject bad parameters, expose the rollup
+# gauges on /metrics, and report the hotspots /healthz section without
+# turning readiness red. Host-bound, so it pins the cpu backend.
+hotspot-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m parca_agent_tpu.tools.hotspot_smoke
 
 # Rebuild the checked-in ELF/DWARF test fixtures and their golden
 # unwind tables (the reference's write-dwarf-unwind-tables pattern,

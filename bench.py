@@ -1679,6 +1679,147 @@ def _last_resort(err: str, rows: int, pids: int) -> dict:
     }
 
 
+def _hotspot_query() -> dict:
+    """`make bench-hotspot`: the hotspot rollup subsystem's acceptance
+    drill (docs/hotspots.md), numpy-only and deterministic.
+
+    A multi-hour simulated window stream (zipf-weighted stack population
+    with per-window Poisson noise, ab_sketch-scale uniques) folds into a
+    HotspotStore through the same WindowSummary.build path the encode
+    worker uses; then:
+
+      * top-K agreement: the store's top-K over the whole range vs the
+        exact aggregate's top-K must agree >= 99% (the acceptance bar),
+        with candidate-exact counts matching the exact sums where the
+        rollup never pruned the key;
+      * query latency: a dashboard-rate burst of random-range queries,
+        p50/p99 reported, p99 bounded;
+      * bounded memory: every level ring must sit at or under its byte
+        cap after the multi-hour fold (oldest-eviction engaged, counted).
+
+    The capture/close thread's zero-work property is owned by the
+    close_overlap phase (this drill never touches an aggregator)."""
+    from parca_agent_tpu.ops.sketch import CountMinSpec
+    from parca_agent_tpu.runtime.hotspots import (
+        HotspotSpec,
+        HotspotStore,
+        WindowSummary,
+    )
+
+    uniques = int(os.environ.get("PARCA_BENCH_HOTSPOT_UNIQUES", 1 << 17))
+    windows = int(os.environ.get("PARCA_BENCH_HOTSPOT_WINDOWS", 720))
+    window_s = 10.0
+    k = 50
+    level_bytes = 24 << 20
+    rng = np.random.default_rng(0xA77)
+    # Distinct 64-bit keys (h1, h2 lanes) for the stack population.
+    h1 = rng.integers(0, 1 << 32, uniques, dtype=np.uint64).astype(np.uint32)
+    h2 = np.arange(uniques, dtype=np.uint32)  # distinct keys by construction
+    # Rank-power-law rates, shuffled so key order carries no hotness
+    # signal: ~35k live rows per window at the default scale — far past
+    # the candidate bound, so every window EXERCISES the top-K pruning
+    # and the cut/estimate machinery (a heavier tail exponent leaves
+    # almost every key dormant and the drill would test nothing).
+    weights = 200.0 / np.arange(1, uniques + 1, dtype=np.float64) ** 0.55
+    rng.shuffle(weights)
+    spec = HotspotSpec(k=k, candidates=1024,
+                       cm=CountMinSpec(depth=4, width=1 << 12))
+    store = HotspotStore(spec=spec, window_s=window_s,
+                         rollup_spans_s=(60.0, 3600.0),
+                         level_bytes=level_bytes)
+    pids = (np.arange(uniques) % 1000).astype(np.int64)
+
+    def ctx_factory(live_idx):
+        def ctx(i):
+            g = int(live_idx[i])
+            return int(pids[g]), (f"app{pids[g]}+0x{g:x}",), \
+                {"pid": str(pids[g])}
+        return ctx
+
+    exact = np.zeros(uniques, np.int64)
+    t_base_ns = 1_700_000_000_000_000_000
+    fold_ms = []
+    for w in range(windows):
+        counts = rng.poisson(weights).astype(np.int64)
+        live = np.flatnonzero(counts)
+        exact += counts
+        t0 = time.perf_counter()
+        s = WindowSummary.build(
+            h1[live], h2[live], counts[live], ctx_factory(live), spec,
+            t_base_ns + int(w * window_s * 1e9), int(window_s * 1e9))
+        store.fold(s)
+        fold_ms.append((time.perf_counter() - t0) * 1e3)
+
+    t0_s = t_base_ns / 1e9
+    t1_s = t0_s + windows * window_s
+    # Top-K agreement over the WHOLE simulated range (served out of the
+    # coarsest rollups) vs the exact aggregate.
+    ans = store.query(k=k, t0_s=t0_s, t1_s=t1_s)
+    got_keys = {e["stack"] for e in ans["entries"]}
+    key64 = (h1.astype(np.uint64) << np.uint64(32)) | h2.astype(np.uint64)
+    top_exact = np.argsort(exact)[-k:]
+    want_keys = {f"0x{int(key64[i]):016x}" for i in top_exact}
+    agreement = len(got_keys & want_keys) / k
+    # Count accuracy on the agreed keys (candidate-exact lower bounds).
+    want_counts = {f"0x{int(key64[i]):016x}": int(exact[i])
+                   for i in top_exact}
+    count_err = [abs(e["count"] - want_counts[e["stack"]])
+                 / max(want_counts[e["stack"]], 1)
+                 for e in ans["entries"] if e["stack"] in want_keys]
+
+    # Dashboard-rate query burst: random ranges at every granularity.
+    q_ms = []
+    n_queries = int(os.environ.get("PARCA_BENCH_HOTSPOT_QUERIES", 200))
+    for _ in range(n_queries):
+        span = float(rng.choice([30, 300, 3600, windows * window_s]))
+        lo = t0_s + float(rng.uniform(0, max(windows * window_s - span, 1)))
+        t0 = time.perf_counter()
+        store.query(k=k, t0_s=lo, t1_s=lo + span)
+        q_ms.append((time.perf_counter() - t0) * 1e3)
+    q_ms.sort()
+    p50 = q_ms[len(q_ms) // 2]
+    p99 = q_ms[min(len(q_ms) - 1, int(len(q_ms) * 0.99))]
+
+    m = store.metrics()
+    local_levels = [lv for lv in m["levels"] if lv["scope"] == "local"]
+    bytes_ok = all(lv["bytes"] <= level_bytes * 1.05 for lv in local_levels)
+    evictions = sum(lv["evictions"] for lv in local_levels)
+
+    phase = {
+        "uniques": uniques,
+        "windows": windows,
+        "simulated_hours": round(windows * window_s / 3600, 2),
+        "k": k,
+        "topk_agreement": round(agreement, 4),
+        "count_err_max": round(max(count_err), 4) if count_err else None,
+        "served_level": ans["level"],
+        "cover": ans["cover"],
+        "answer_exact": ans["exact"],
+        "fold_ms_median": round(_median_ms([t / 1e3 for t in fold_ms]), 2),
+        "fold_ms_max": round(max(fold_ms), 2),
+        "query_p50_ms": round(p50, 3),
+        "query_p99_ms": round(p99, 3),
+        "queries": n_queries,
+        "level_bytes_cap": level_bytes,
+        "level_bytes": {f"{lv['scope']}/{lv['name']}": lv["bytes"]
+                        for lv in m["levels"] if lv["scope"] == "local"},
+        "rollup_bytes_ok": bytes_ok,
+        "evictions": evictions,
+        "windows_folded": m["windows_folded"],
+    }
+    if agreement < 0.99:
+        phase["error"] = (f"top-{k} agreement {agreement:.3f} < 0.99 vs "
+                          "the exact aggregate")
+    elif not bytes_ok:
+        phase["error"] = "a rollup level ring exceeded its byte cap"
+    elif p99 > 250.0:
+        phase["error"] = f"query p99 {p99:.1f} ms > 250 ms"
+    elif evictions == 0:
+        phase["error"] = ("multi-hour fold never evicted: the byte cap "
+                          "was not exercised")
+    return phase
+
+
 def _finalize_result(result: dict, device_alive: bool,
                      probe_log: list | None = None,
                      attempt_hung: bool = False,
@@ -1803,6 +1944,21 @@ def _close_main() -> None:
     print(json.dumps({"metric": "close_overlap", **phase}))
 
 
+def _hotspot_main() -> None:
+    """`make bench-hotspot`: the hotspot rollup drill alone, one JSON
+    line. Numpy-only — the backend stamp just records the pin."""
+    try:
+        phase = _hotspot_query()
+    except Exception as e:  # noqa: BLE001 - the line must still print
+        phase = {"error": repr(e)[:300]}
+    import jax
+
+    phase["backend"] = jax.default_backend()
+    _finalize_result(phase, device_alive=True,
+                     require_full_scale=False, require_device=False)
+    print(json.dumps({"metric": "hotspot_query", **phase}))
+
+
 def _child_main() -> None:
     """The measurement process: no supervision, just run and print."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
@@ -1826,6 +1982,9 @@ def main() -> None:
         return
     if os.environ.get("PARCA_BENCH_CLOSE_CHILD"):
         _close_main()
+        return
+    if os.environ.get("PARCA_BENCH_HOTSPOT_CHILD"):
+        _hotspot_main()
         return
     if os.environ.get("PARCA_BENCH_PROBE_CHILD"):
         _probe_main()

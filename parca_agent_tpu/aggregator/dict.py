@@ -435,6 +435,15 @@ class DictAggregator:
         self._id_pid = np.empty(1024, np.int32)
         self._loc_off = np.zeros(1025, np.int64)
         self._loc_flat = np.empty(4096, np.int32)
+        # Per-id content hashes (the h1/h2 identity lanes of the key
+        # tuple, in id order): the cross-node join key. The fleet merge
+        # and the hotspot rollups (runtime/hotspots.py) key summaries by
+        # (h1 << 32 | h2) — content-stable across hosts — and reading
+        # them per id here costs one vectorized copy at insert time
+        # instead of an O(dict) inversion of _key_to_id per window.
+        # Published under the same _published watermark as _id_pid.
+        self._id_h1 = np.empty(1024, np.uint32)
+        self._id_h2 = np.empty(1024, np.uint32)
         self._pids: dict[int, _PidRegistry] = {}
         # Bumped whenever any per-pid registry may have changed (insert
         # batches, adoption, rotation). Statics consumers use it to skip
@@ -551,6 +560,17 @@ class DictAggregator:
         self._needs_reset = True
 
     # -- registry identity (statics snapshot support) ------------------------
+
+    def id_hashes(self, n: int | None = None):
+        """Per-id content hashes (h1, h2) for ids [0, n) — the host/
+        device-stable identity lanes every cross-node consumer keys on
+        (fleet merge, hotspot rollups). ``n`` defaults to the published
+        watermark; callers off the mutating thread must pass ids they
+        observed at or below a _published they read earlier (the same
+        contract as every other per-id mirror read)."""
+        if n is None:
+            n = self._published
+        return self._id_h1[:n], self._id_h2[:n]
 
     @property
     def registry_epoch(self) -> int:
@@ -1116,15 +1136,13 @@ class DictAggregator:
     def _sketch_add(self, hashes: np.ndarray, counts: np.ndarray) -> None:
         """Absorb overflow rows into the count-min table + HLL registers
         (bounded memory; overestimate-only error per CountMinSpec)."""
-        from parca_agent_tpu.ops.sketch import cm_buckets, hll_build, hll_merge
+        from parca_agent_tpu.ops.sketch import cm_add, hll_build, hll_merge
 
         if self._cm is None:
             self._cm = np.zeros(
                 (self._cm_spec.depth, self._cm_spec.width), np.int64)
             self._over_hll = np.zeros(self._hll_spec.m, np.int32)
-        b = cm_buckets(hashes, self._cm_spec)
-        for d in range(self._cm_spec.depth):
-            np.add.at(self._cm[d], b[d], counts)
+        cm_add(self._cm, hashes, counts, self._cm_spec)
         self._over_hll = hll_merge(
             self._over_hll, hll_build(hashes, self._hll_spec))
         self.stats["sketch_rows"] = \
@@ -1185,6 +1203,8 @@ class DictAggregator:
         lens = off[kept + 1] - off[kept]
         new_flat, new_off = ragged_gather(self._loc_flat, off[kept], lens)
         self._id_pid = self._id_pid[:n][kept].copy()
+        self._id_h1 = self._id_h1[:n][kept].copy()
+        self._id_h2 = self._id_h2[:n][kept].copy()
         self._loc_flat = new_flat
         self._loc_off = new_off
         new_last = np.zeros(self._id_cap, np.int32)
@@ -1329,6 +1349,20 @@ class DictAggregator:
                              np.array(absorb_c, np.int64))
 
         if new_slots:
+            # Per-id hash lanes land BEFORE _register_stacks_bulk
+            # publishes the batch (_append_id_meta advances _published),
+            # so concurrent readers pacing by the watermark never see an
+            # id without its hashes.
+            base = self._next_id - len(new_slots)
+            if self._next_id > len(self._id_h1):
+                for name in ("_id_h1", "_id_h2"):
+                    old = getattr(self, name)
+                    grown = np.empty(max(self._next_id, 2 * len(old)),
+                                     np.uint32)
+                    grown[:base] = old[:base]
+                    setattr(self, name, grown)
+            self._id_h1[base:self._next_id] = self._h1[new_slots]
+            self._id_h2[base:self._next_id] = self._h2[new_slots]
             self._register_stacks_bulk(snapshot, np.array(new_rows, np.int64))
             slots = np.array(new_slots, np.int64)
             vals = np.zeros((len(new_slots), 4), np.uint32)

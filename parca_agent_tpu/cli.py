@@ -202,6 +202,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "statics cache (digest of build inputs -> built "
                         "bytes; rotation/restart rebuilds become lookups "
                         "and identical-layout pids share one blob)")
+    p.add_argument("--hotspots", action="store_true",
+                   help="maintain hotspot rollups (docs/hotspots.md): "
+                        "each shipped window is folded into mergeable "
+                        "count-min + top-K summaries on the encode "
+                        "worker, rolled up per-window -> 1 min -> 1 h in "
+                        "bounded memory, and served from /hotspots "
+                        "('top-K hottest stacks matching this label "
+                        "selector over this time range'). Requires "
+                        "--fast-encode with the encode pipeline; with a "
+                        "fleet configured, merge rounds also feed a "
+                        "fleet-wide scope")
+    p.add_argument("--hotspot-top-k", type=int, default=50,
+                   help="default K served per /hotspots query (callers "
+                        "may ask for less or up to the candidate bound)")
+    p.add_argument("--hotspot-candidates", type=int, default=512,
+                   help="exact top-candidate entries kept per summary — "
+                        "the exactness headroom above K; absent stacks "
+                        "fall back to the count-min estimate")
+    p.add_argument("--hotspot-cm-depth", type=int, default=4,
+                   help="count-min rows per rollup summary")
+    p.add_argument("--hotspot-cm-width", type=int, default=1 << 12,
+                   help="count-min buckets per row (power of two); the "
+                        "point-query overestimate bound is e/width of "
+                        "the summary's total mass")
+    p.add_argument("--hotspot-rollup-intervals", default="60,3600",
+                   help="comma-separated rollup bucket spans in seconds "
+                        "(finest to coarsest) above the per-window level")
+    p.add_argument("--hotspot-level-bytes", type=int, default=32 << 20,
+                   help="byte cap per rollup level ring; past it the "
+                        "OLDEST summaries are evicted (counted)")
+    p.add_argument("--hotspot-stale-after", type=float, default=60.0,
+                   help="seconds without a completed fleet merge round "
+                        "before fleet-scope answers are flagged stale")
     p.add_argument("--streaming-window", action="store_true",
                    help="feed each capture drain to the aggregation device "
                         "DURING the window (perf capture + dict aggregator "
@@ -718,6 +751,52 @@ def run(argv=None) -> int:
             statics_store = StaticsStore(
                 args.statics_snapshot_path,
                 max_age_s=args.statics_snapshot_max_age or None)
+
+    # -- hotspot rollups (docs/hotspots.md) ----------------------------------
+    # The read path: window summaries fold on the encode worker, rollup
+    # rings answer /hotspots, and (when a fleet is up) merge rounds feed
+    # the fleet scope through the merger's degrade-safe collectives.
+    hotspot_store = None
+    if args.hotspots:
+        if not (args.fast_encode and not args.no_encode_pipeline):
+            log.warn("--hotspots needs --fast-encode with the encode "
+                     "pipeline; hotspot rollups disabled")
+        else:
+            from parca_agent_tpu.ops.sketch import CountMinSpec
+            from parca_agent_tpu.runtime.hotspots import (
+                HotspotSpec,
+                HotspotStore,
+            )
+
+            try:
+                spans = tuple(
+                    float(s) for s in
+                    filter(None, args.hotspot_rollup_intervals.split(",")))
+                if any(not (s > 0) for s in spans):  # rejects NaN too
+                    raise ValueError
+            except ValueError:
+                raise SystemExit("bad --hotspot-rollup-intervals "
+                                 f"{args.hotspot_rollup_intervals!r} "
+                                 "(comma-separated positive seconds)")
+            try:
+                hotspot_store = HotspotStore(
+                    spec=HotspotSpec(
+                        k=args.hotspot_top_k,
+                        candidates=max(args.hotspot_candidates,
+                                       args.hotspot_top_k),
+                        cm=CountMinSpec(depth=args.hotspot_cm_depth,
+                                        width=args.hotspot_cm_width)),
+                    window_s=args.profiling_duration,
+                    rollup_spans_s=spans,
+                    level_bytes=args.hotspot_level_bytes,
+                    stale_after_s=args.hotspot_stale_after)
+            except ValueError as e:
+                # The spec dataclasses validate (k >= 1, candidates >=
+                # k, power-of-two width...): an operator typo should be
+                # a readable startup error, not a traceback.
+                raise SystemExit(f"bad --hotspot-* flags: {e}")
+            if fleet_merger is not None:
+                fleet_merger.attach_hotspots(hotspot_store)
     profiler = CPUProfiler(
         source=source,
         aggregator=aggregator,
@@ -744,6 +823,7 @@ def run(argv=None) -> int:
         statics_snapshot_every=args.statics_snapshot_interval,
         statics_cache_bytes=args.statics_cache_bytes,
         trace_recorder=recorder,
+        hotspot_store=hotspot_store,
     )
 
     if statics_store is not None and profiler._encoder is not None:
@@ -867,7 +947,8 @@ def run(argv=None) -> int:
                            supervisor=sup, quarantine=quarantine,
                            device_health=device_health,
                            statics_store=statics_store,
-                           recorder=recorder)
+                           recorder=recorder,
+                           hotspots=hotspot_store)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None

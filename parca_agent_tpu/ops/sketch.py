@@ -104,6 +104,20 @@ def cm_merge(a, b):
     return a + b
 
 
+def cm_add(table, hashes, counts, spec: CountMinSpec) -> None:
+    """Accumulate an item stream into an EXISTING host table in place
+    (numpy only). The streaming twin of cm_build for long-lived tables —
+    the dict aggregator's overflow sideband and the hotspot rollup
+    summaries both fold windows into a table they keep, rather than
+    building a fresh one per batch. Same bucket derivation as cm_build,
+    so in-place accumulation, cm_build over the concatenated stream, and
+    cm_merge of per-batch tables are all elementwise-identical."""
+    b = cm_buckets(np.asarray(hashes, np.uint32), spec)
+    counts = np.asarray(counts)
+    for d in range(spec.depth):
+        np.add.at(table[d], b[d], counts)
+
+
 @dataclasses.dataclass(frozen=True)
 class HLLSpec:
     """2^p registers; relative error ~= 1.04 / sqrt(2^p)."""

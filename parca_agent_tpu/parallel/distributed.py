@@ -263,6 +263,12 @@ class FleetWindowMerger:
             "rejoin_probes_failed": 0,
         }
         self.last_degrade_error: str = ""
+        # Hotspot rollup rider (runtime/hotspots.py attach_hotspots):
+        # every successful merge round's fleet-deduped stream feeds the
+        # store's fleet-scope rollups; a degrade notifies it so queries
+        # flag node-local answers stale. Strictly best-effort — rollup
+        # trouble must never break the merge schedule.
+        self._hotspots = None
         # Hang observability: a PEER's failure leaves this node blocked
         # inside the next collective with failed=None and frozen last-good
         # gauges. These two clocks make that state visible from /metrics
@@ -271,6 +277,13 @@ class FleetWindowMerger:
         # no collective timeout configured they are the ONLY signal).
         self.last_round_at: float | None = None
         self.round_started_at: float | None = None
+
+    def attach_hotspots(self, store) -> None:
+        """Feed a HotspotStore's fleet scope from this merger's rounds
+        (the cross-node read path, docs/hotspots.md). The store learns
+        the merge cadence so it can judge staleness."""
+        store.fleet_interval_s = self._interval
+        self._hotspots = store
 
     def submit_window(self, hashes, counts) -> None:
         """Called after each window close. `hashes` is (h1, h2) row
@@ -367,11 +380,17 @@ class FleetWindowMerger:
             h1 = np.ascontiguousarray(h1, np.uint32)
             h2 = np.ascontiguousarray(h2, np.uint32)
         try:
-            u1, _, uc = self._bounded(
+            u1, u2, uc = self._bounded(
                 lambda: self._merge_collective(h1, h2, counts))
         except Exception as e:  # noqa: BLE001 - degrade, never wedge
             self._degrade(e)
             return
+        if self._hotspots is not None:
+            try:
+                self._hotspots.fleet_fold(u1, u2, uc)
+            except Exception as e:  # noqa: BLE001 - rollup is best-effort
+                log.warn("fleet hotspot rollup failed; round counted, "
+                         "rollup skipped", error=repr(e))
         self.fleet_stats = {
             "fleet_total_samples": int(uc.astype(np.int64).sum()),
             "fleet_unique_stacks": int(len(u1)),
@@ -385,6 +404,11 @@ class FleetWindowMerger:
         if isinstance(e, CollectiveTimeout):
             self.stats["collective_timeouts"] += 1
         self.last_degrade_error = repr(e)[:200]
+        if self._hotspots is not None:
+            try:
+                self._hotspots.fleet_degraded(self.last_degrade_error)
+            except Exception:  # noqa: BLE001 - notification only
+                pass
         self._rejoin_backoff = self._rejoin_base
         self._rejoin_in = self._rejoin_backoff
         self.round_started_at = None

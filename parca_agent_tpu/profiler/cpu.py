@@ -90,6 +90,7 @@ class CPUProfiler:
         statics_snapshot_every: int = 6,
         statics_cache_bytes: int = 256 << 20,
         trace_recorder=None,
+        hotspot_store=None,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -141,6 +142,15 @@ class CPUProfiler:
         # pipeline — without a worker there is no thread that may safely
         # serialize the encoder's statics map off the capture path.
         self._statics_store = statics_store
+        # Hotspot rollups (runtime/hotspots.py): each shipped window is
+        # folded into mergeable sketch+top-K summaries ON THE ENCODE
+        # WORKER — the read path (/hotspots) must add zero work to the
+        # capture/close thread, so without the pipeline there is no
+        # thread the fold may ride and the store stays unfed.
+        self._hotspots = hotspot_store
+        if hotspot_store is not None and labels_manager is not None \
+                and hotspot_store.labels_for is None:
+            hotspot_store.labels_for = self._locked_labels_for
         if encode_pipeline:
             if self._encoder is None:
                 raise ValueError("encode_pipeline requires fast_encode")
@@ -156,10 +166,18 @@ class CPUProfiler:
                 self._encoder, ship=self._ship_encoded,
                 snapshot=snapshot,
                 snapshot_every=(statics_snapshot_every
-                                if statics_store is not None else 0))
-        elif statics_store is not None:
-            _log.warn("statics snapshotting needs the encode pipeline; "
-                      "snapshots disabled (adoption still works)")
+                                if statics_store is not None else 0),
+                rollup=(self._rollup_window
+                        if hotspot_store is not None else None),
+                rollup_capture=(self._rollup_capture
+                                if hotspot_store is not None else None))
+        else:
+            if statics_store is not None:
+                _log.warn("statics snapshotting needs the encode pipeline; "
+                          "snapshots disabled (adoption still works)")
+            if hotspot_store is not None:
+                _log.warn("hotspot rollups need the encode pipeline; "
+                          "windows will not be folded")
         self._encode_deadline = encode_deadline_s
         self._encode_inflight = None   # abandoned inline deadline encode
         self._encode_abandoned = None  # its result box (error inspection)
@@ -556,6 +574,28 @@ class CPUProfiler:
         if self._labels is not None:
             return self._labels.label_set("parca_agent_cpu", pid)
         return {"__name__": "parca_agent_cpu", "pid": str(pid)}
+
+    def _locked_labels_for(self, pid: int) -> dict | None:
+        """Label lookup under the write lock — the same serialization
+        _write_one uses, so the rollup fold (encode worker) and the ship
+        paths never race the labels manager's caches."""
+        with self._write_mu:
+            return self._labels_for(pid)
+
+    def _rollup_capture(self, prep):
+        """EncodePipeline rollup-capture hook (PROFILER thread, at window
+        hand-off): snapshot the per-id mirror references the fold will
+        read, before the next window's first feed can rotate them."""
+        from parca_agent_tpu.runtime.hotspots import RegistryView
+
+        return RegistryView(self._aggregator)
+
+    def _rollup_window(self, prep, ctx) -> None:
+        """EncodePipeline rollup hook (worker thread): fold the shipped
+        window's live (id, count) rows into the hotspot store, reading
+        per-id state only through the hand-off-time registry view."""
+        self._hotspots.fold_from_aggregator(
+            ctx, prep.idx, prep.vals, prep.time_ns, prep.duration_ns)
 
     def _write_one(self, pid: int, payload) -> bool:
         """Labels lookup + write + bookkeeping for one profile; False when

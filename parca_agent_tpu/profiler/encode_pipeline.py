@@ -59,11 +59,23 @@ class EncodePipeline:
 
     def __init__(self, encoder, ship, ship_views: bool = True,
                  name: str = THREAD_NAME, snapshot=None,
-                 snapshot_every: int = 0):
+                 snapshot_every: int = 0, rollup=None,
+                 rollup_capture=None):
         self._enc = encoder
         self._ship = ship
         self._views = ship_views
         self._name = name
+        # Hotspot rollup hook (runtime/hotspots.py): a `rollup(prep, ctx)`
+        # callable run on THIS worker thread after every shipped window.
+        # `ctx` is whatever `rollup_capture(prep)` returned on the
+        # PROFILER thread at hand-off — a rotation-consistent registry
+        # view; the fold must read per-id mirrors through it, because a
+        # cold-stack rotation (profiler thread, next window's first
+        # feed) compacts the live arrays under a still-running fold.
+        # Errors are counted, never fatal: a rollup bug costs query
+        # freshness, not a window.
+        self._rollup = rollup
+        self._rollup_capture = rollup_capture
         # Warm statics snapshot hook (pprof/statics_store.py): a
         # `snapshot(period_ns)` callable run on THIS worker thread after
         # every snapshot_every-th shipped window — the one thread that
@@ -73,7 +85,7 @@ class EncodePipeline:
         self._snapshot = snapshot
         self._snapshot_every = snapshot_every
         self._cond = threading.Condition()
-        self._window = None          # pending (prep, fallback) hand-off
+        self._window = None   # pending (prep, ctx, fallback, trace) hand-off
         self._prebuild = None        # latest coalesced (period_ns, budget_s)
         self._state = "idle"         # idle | encode | prebuild
         self._handoff = False        # profiler parked the worker
@@ -96,6 +108,9 @@ class EncodePipeline:
             "snapshots_written": 0,
             "snapshot_errors": 0,
             "last_snapshot_s": 0.0,
+            "windows_rolled": 0,
+            "rollup_errors": 0,
+            "last_rollup_s": 0.0,
         }
 
     # -- profiler-thread API -------------------------------------------------
@@ -135,12 +150,22 @@ class EncodePipeline:
                 self._cond.notify_all()
             raise
         trace.detach()
+        rollup_ctx = None
+        if self._rollup is not None and self._rollup_capture is not None:
+            # Still the profiler thread: rotation cannot interleave, so
+            # the captured view brackets the prepared ids exactly.
+            try:
+                rollup_ctx = self._rollup_capture(prep)
+            except Exception as e:  # noqa: BLE001 - rollup is best-effort
+                self.stats["rollup_errors"] += 1
+                _log.warn("hotspot rollup capture failed; window will "
+                          "ship unfolded", error=repr(e))
         with self._cond:
             # Enqueue and unpark in ONE lock acquisition: clearing
             # _handoff first would let a pending prebuild slip in ahead
             # of the window (with _interrupt already cleared, nothing
             # would yield it) and delay the encode by a whole budget.
-            self._window = (prep, fallback, trace)
+            self._window = (prep, rollup_ctx, fallback, trace)
             self._handoff = False
             self._interrupt.clear()
             self._cond.notify_all()
@@ -238,7 +263,7 @@ class EncodePipeline:
                     self.stats["prebuilds"] += 1
             except Exception as e:  # noqa: BLE001 - surfaced via disable
                 if job[0] == "window":
-                    self._fail_window(e, job[1][1], job[1][2])
+                    self._fail_window(e, job[1][2], job[1][3])
                     with self._cond:
                         self._state = "idle"
                         self._cond.notify_all()
@@ -269,7 +294,8 @@ class EncodePipeline:
         self.last_error = None
         _log.info("encode pipeline revived")
 
-    def _do_window(self, prep, fallback, trace=NULL_TRACE) -> None:
+    def _do_window(self, prep, rollup_ctx, fallback,
+                   trace=NULL_TRACE) -> None:
         t0 = time.perf_counter()
         # Chaos site: an injected crash here is a worker death — the
         # window ships via the caller's fallback, the pipeline disables,
@@ -316,6 +342,23 @@ class EncodePipeline:
         trace.add_span("ship", ship_s)
         self.stats["windows_pipelined"] += 1
         trace.complete()
+        if self._rollup is not None and (rollup_ctx is not None
+                                         or self._rollup_capture is None):
+            # Hotspot fold on the window clock, after the ship: a fold
+            # failure can neither delay nor lose the window, and the
+            # capture thread never sees this work at all. A window whose
+            # hand-off capture failed (ctx None with a capture hook
+            # configured) ships unfolded — folding it off the live
+            # aggregator would reopen the rotation race.
+            t0 = time.perf_counter()
+            try:
+                self._rollup(prep, rollup_ctx)
+                self.stats["windows_rolled"] += 1
+            except Exception as e:  # noqa: BLE001 - rollup is best-effort
+                self.stats["rollup_errors"] += 1
+                _log.warn("hotspot rollup failed on the encode worker",
+                          error=repr(e))
+            self.stats["last_rollup_s"] = time.perf_counter() - t0
         if self._snapshot is not None and self._snapshot_every > 0 \
                 and self.stats["windows_pipelined"] \
                 % self._snapshot_every == 0:

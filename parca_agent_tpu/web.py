@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import html
 import json
+import math
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -132,7 +133,7 @@ class _MetricsBuffer:
 def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    supervisor=None, quarantine=None,
                    device_health=None, statics_store=None,
-                   recorder=None) -> str:
+                   recorder=None, hotspots=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics and
     the window flight recorder's stage histograms
@@ -317,6 +318,34 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
         for k, v in recorder.stats.items():
             name = f"parca_agent_trace_{k}"
             emit(name if name.endswith("_total") else name + "_total", v)
+    if hotspots is not None:
+        # Hotspot rollup observability (docs/hotspots.md): per-level
+        # ring population/footprint/evictions for BOTH scopes, fold and
+        # query counters, and the fleet-round health the degrade path
+        # promises operators (ok/degraded rounds, staleness, age).
+        m = hotspots.metrics()
+        for lv in m["levels"]:
+            lab = {"level": lv["name"], "scope": lv["scope"]}
+            emit("parca_agent_hotspot_level_summaries", lv["summaries"],
+                 lab)
+            emit("parca_agent_hotspot_level_bytes", lv["bytes"], lab)
+            emit("parca_agent_hotspot_level_evictions_total",
+                 lv["evictions"], lab)
+        emit("parca_agent_hotspot_windows_folded_total",
+             m["windows_folded"])
+        emit("parca_agent_hotspot_fold_errors_total", m["fold_errors"])
+        emit("parca_agent_hotspot_last_fold_seconds",
+             round(m["last_fold_s"], 6))
+        emit("parca_agent_hotspot_queries_total", m["queries_total"])
+        emit("parca_agent_hotspot_query_errors_total", m["query_errors"])
+        emit("parca_agent_hotspot_context_entries", m["context_entries"])
+        emit("parca_agent_hotspot_fleet_rounds_ok_total",
+             m["fleet_rounds_ok"])
+        emit("parca_agent_hotspot_fleet_rounds_degraded_total",
+             m["fleet_rounds_degraded"])
+        emit("parca_agent_hotspot_fleet_stale", int(m["stale"]))
+        if "fleet_age_s" in m:
+            emit("parca_agent_hotspot_fleet_age_seconds", m["fleet_age_s"])
     for k, v in (extra or {}).items():
         # Extra metrics may arrive with pre-rendered labels
         # ("name{k=\"v\"}"): split so the family still gets its TYPE
@@ -332,7 +361,8 @@ class AgentHTTPServer:
                  profilers=(), batch_client=None, listener=None,
                  version: str = "dev", extra_metrics=None,
                  capture_info=None, supervisor=None, quarantine=None,
-                 device_health=None, statics_store=None, recorder=None):
+                 device_health=None, statics_store=None, recorder=None,
+                 hotspots=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -361,13 +391,16 @@ class AgentHTTPServer:
                         quarantine=outer.quarantine,
                         device_health=outer.device_health,
                         statics_store=outer.statics_store,
-                        recorder=outer.recorder).encode())
+                        recorder=outer.recorder,
+                        hotspots=outer.hotspots).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
                     self._healthz()
                 elif url.path == "/query":
                     self._query(url)
+                elif url.path == "/hotspots":
+                    self._hotspots(url)
                 elif url.path == "/debug/windows":
                     self._debug_windows(url)
                 elif url.path.startswith("/debug/trace/"):
@@ -471,6 +504,8 @@ class AgentHTTPServer:
                           if outer.device_health is not None else None)
                 statics = (outer.statics_store.snapshot_info()
                            if outer.statics_store is not None else None)
+                hotspots = (outer.hotspots.snapshot()
+                            if outer.hotspots is not None else None)
                 if outer.supervisor is None:
                     body = {"status": "healthy", "actors": {}}
                     if quarantine is not None:
@@ -479,6 +514,8 @@ class AgentHTTPServer:
                         body["device"] = device
                     if statics is not None:
                         body["statics"] = statics
+                    if hotspots is not None:
+                        body["hotspots"] = hotspots
                     self._send(200, json.dumps(body).encode(),
                                "application/json")
                     return
@@ -503,6 +540,12 @@ class AgentHTTPServer:
                     # readiness one: a cold (absent/stale/corrupt)
                     # snapshot just means the next restart rebuilds.
                     body["statics"] = statics
+                if hotspots is not None:
+                    # The hotspot rollups are a READ-path convenience:
+                    # stale fleet state or evicted rings degrade query
+                    # answers, never the agent's readiness — by contract
+                    # this section can never turn /healthz red.
+                    body["hotspots"] = hotspots
                 self._send(503 if status == "dead" else 200,
                            json.dumps(body, indent=1).encode(),
                            "application/json")
@@ -516,6 +559,53 @@ class AgentHTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _hotspots(self, url):
+                """Top-K hottest stacks from the pre-merged rollups
+                (docs/hotspots.md): ?k=N, ?t0=/-t1= (unix seconds) or
+                ?range=S (seconds back from now), ?scope=local|fleet;
+                every other parameter is a label selector term. Answers
+                come from sealed summaries — this handler never touches
+                the capture/close path."""
+                if outer.hotspots is None:
+                    self._send(503, b"hotspot rollups not enabled\n")
+                    return
+                params = dict(urllib.parse.parse_qsl(url.query))
+                try:
+                    k = int(params.pop("k")) if "k" in params else None
+                    scope = params.pop("scope", "local")
+                    t0_s = t1_s = None
+                    if "range" in params:
+                        import time as _time
+
+                        rng = float(params.pop("range"))
+                        if not math.isfinite(rng) or rng <= 0:
+                            raise ValueError("bad range")
+                        t1_s = _time.time()
+                        t0_s = t1_s - rng
+                    if "t0" in params:
+                        t0_s = float(params.pop("t0"))
+                    if "t1" in params:
+                        t1_s = float(params.pop("t1"))
+                    for t in (t0_s, t1_s):
+                        # Same finiteness discipline as ?range= and
+                        # _query's timeout: ?t0=inf (or a float whose
+                        # *1e9 overflows int conversion) must be a 400,
+                        # not a dropped connection.
+                        if t is not None and not math.isfinite(t):
+                            raise ValueError("non-finite t0/t1")
+                    if (k is not None and k < 1) \
+                            or scope not in ("local", "fleet"):
+                        raise ValueError("bad k/scope")
+                    body = outer.hotspots.query(
+                        k=k, t0_s=t0_s, t1_s=t1_s, selector=params,
+                        scope=scope)
+                except (ValueError, TypeError, OverflowError) as e:
+                    outer.hotspots.count_query_error()
+                    self._send(400, f"bad hotspot query: {e}\n".encode())
+                    return
+                self._send(200, json.dumps(body, indent=1).encode(),
+                           "application/json")
+
             def _query(self, url):
                 if outer.listener is None:
                     self._send(503, b"no listener\n")
@@ -526,6 +616,14 @@ class AgentHTTPServer:
                 except ValueError:
                     self._send(400, b"bad timeout parameter\n")
                     return
+                # Clamp to [0, 60]: a huge (or NaN/inf) timeout used to
+                # park a server thread on the listener indefinitely —
+                # negative/non-finite is a caller bug (400), anything
+                # past a minute is capped, not honored.
+                if not math.isfinite(timeout) or timeout < 0:
+                    self._send(400, b"bad timeout parameter\n")
+                    return
+                timeout = min(timeout, 60.0)
                 want = params
 
                 def match(labels):
@@ -552,6 +650,7 @@ class AgentHTTPServer:
         self.device_health = device_health
         self.statics_store = statics_store
         self.recorder = recorder
+        self.hotspots = hotspots
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info

@@ -1,0 +1,201 @@
+"""Chaos drill for the hotspot rollup subsystem (docs/hotspots.md):
+a `fleet.collective:hang` through the fleet rollup round must degrade
+queries to flagged node-local answers WITHOUT losing a single window —
+the capture/encode loop keeps shipping and folding — and after the
+injector clears, the rejoin probe re-enters the schedule and fleet
+answers go fresh again. Deterministic under the fixed seed; rides the
+`chaos` marker (`make chaos`)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.aggregator.cpu import CPUAggregator
+from parca_agent_tpu.aggregator.dict import DictAggregator
+from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+from parca_agent_tpu.ops.hashing import row_hash_np
+from parca_agent_tpu.ops.sketch import CountMinSpec
+from parca_agent_tpu.profiler.cpu import CPUProfiler
+from parca_agent_tpu.runtime.hotspots import HotspotSpec, HotspotStore
+from parca_agent_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.install(None)
+
+
+def _wait(cond, timeout=10.0, tick=0.005):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(tick)
+    return True
+
+
+def _single_node_merger(**kw):
+    """A FleetWindowMerger over the implicit single-process group, its
+    exact-merge shard_map program stubbed with the numpy oracle — the
+    machinery under drill is the bound/degrade/rejoin layer plus the
+    hotspot rollup rider, not the collective math (tests/test_fleet.py
+    owns that). The fleet.collective chaos site still fires first, like
+    the real program."""
+    from parca_agent_tpu.parallel.distributed import FleetWindowMerger
+
+    m = FleetWindowMerger(interval_s=0.0, **kw)
+
+    def merge(h1, h2, counts):
+        faults.inject("fleet.collective")
+        key = (h1.astype(np.uint64) << np.uint64(32)) | h2.astype(np.uint64)
+        uniq, inv = np.unique(key, return_inverse=True)
+        sums = np.zeros(len(uniq), np.int64)
+        np.add.at(sums, inv, counts.astype(np.int64))
+        u1 = (uniq >> np.uint64(32)).astype(np.uint32)
+        u2 = uniq.astype(np.uint32)
+        return u1, u2, sums.astype(np.int32)
+
+    m._merge_collective = merge
+    m._probe_collective = lambda: faults.inject("fleet.collective")
+    return m
+
+
+class _Sink:
+    def write(self, labels, blob):
+        pass
+
+
+def _snap(seed):
+    return generate(SyntheticSpec(
+        n_pids=4, n_unique_stacks=64, n_rows=64, total_samples=512,
+        mean_depth=6, seed=seed))
+
+
+def test_collective_hang_degrades_rollup_answers_then_recovers():
+    store = HotspotStore(
+        spec=HotspotSpec(k=5, candidates=256,
+                         cm=CountMinSpec(depth=3, width=1 << 8)),
+        window_s=10.0)
+    merger = _single_node_merger(collective_timeout_s=0.1,
+                                 rejoin_after_rounds=1)
+    merger.attach_hotspots(store)
+
+    snaps = [_snap(i) for i in range(6)]
+
+    class Src:
+        def __init__(self):
+            self.snaps = list(snaps)
+
+        def poll(self):
+            return self.snaps.pop(0) if self.snaps else None
+
+    def sink(snapshot):
+        merger.submit_window(
+            lambda s=snapshot: row_hash_np(s.stacks, s.pids, s.user_len,
+                                           s.kernel_len, n_hashes=2),
+            snapshot.counts)
+
+    prof = CPUProfiler(
+        source=Src(), aggregator=DictAggregator(capacity=1 << 12),
+        fallback_aggregator=CPUAggregator(), profile_writer=_Sink(),
+        duration_s=0.0, fast_encode=True, encode_pipeline=True,
+        window_sink=sink, hotspot_store=store)
+    try:
+        # -- healthy round: fleet scope is served fresh ----------------------
+        assert prof.run_iteration()
+        # Let the worker fold the window first so the fleet round's
+        # context join sees the locally-learned frames.
+        assert prof._pipeline.flush(30)
+        merger.merge_round()
+        assert store.stats["fleet_rounds_ok"] == 1
+        ans = store.query(scope="fleet")
+        assert not ans["stale"] and "fallback" not in ans
+        assert ans["total_samples"] == 512
+        # Context joined from the local folds: human-readable frames.
+        assert any(not e["frames"][0].startswith("stack:")
+                   for e in ans["entries"])
+
+        # -- hung collective: degrade, keep shipping, keep answering ---------
+        faults.install(faults.FaultInjector.from_spec(
+            "fleet.collective:hang:ms=600,count=1", seed=42))
+        assert prof.run_iteration()
+        merger.merge_round()                 # wedged -> degraded
+        assert merger.degraded
+        assert store.stats["fleet_rounds_degraded"] == 1
+        ans = store.query(scope="fleet")
+        assert ans["stale"] and ans["degraded"]
+        assert ans["entries"], "degraded fleet scope stopped answering"
+        # Node-local answers are untouched by the fleet outage.
+        local = store.query(scope="local")
+        assert not local["stale"] and local["entries"]
+
+        # The window loop never blocked on the hung peer: every window
+        # keeps shipping and folding through the degraded rounds (the
+        # per-window flush keeps the drill deterministic — no
+        # backpressure fallbacks from the test driving windows faster
+        # than production ever would).
+        while prof.run_iteration():
+            assert prof._pipeline.flush(30)
+            merger.merge_round()             # local-only, counted
+        assert prof._pipeline.quiesce(30)
+        assert prof._pipeline.stats["windows_lost"] == 0
+        assert prof._pipeline.stats["windows_pipelined"] == len(snaps)
+        assert prof._pipeline.stats["windows_rolled"] == len(snaps)
+        assert store.stats["windows_folded"] == len(snaps)
+        assert merger.stats["local_only_rounds"] >= 1
+        assert merger.failed is None
+
+        # -- injector clear: rejoin probe, fresh fleet answers ---------------
+        assert _wait(merger._inflight_clear, timeout=10)
+        for _ in range(6):
+            merger.merge_round()
+            if not merger.degraded:
+                break
+        assert not merger.degraded
+        assert merger.stats["rejoins"] == 1
+        h1, h2, _h3 = row_hash_np(snaps[0].stacks, snaps[0].pids,
+                                  snaps[0].user_len, snaps[0].kernel_len,
+                                  n_hashes=3)
+        merger.submit_window((h1, h2),
+                             snaps[0].counts.astype(np.int32))
+        merger.merge_round()
+        # >= 2: the rejoin probe may have re-entered the schedule while
+        # the degraded-round loop above was still submitting windows.
+        assert store.stats["fleet_rounds_ok"] >= 2
+        ans = store.query(scope="fleet")
+        assert not ans["stale"] and not ans["degraded"]
+    finally:
+        prof._pipeline.close(10)
+
+
+def test_fleet_rollup_failure_never_breaks_the_merge_schedule():
+    """A rollup bug (the store raising) must cost the round's rollup,
+    not the fleet schedule: the merger counts the round as completed."""
+    class Exploding:
+        fleet_interval_s = 0.0
+
+        def fleet_fold(self, *a, **k):
+            raise RuntimeError("rollup bug")
+
+        def fleet_degraded(self, error=""):
+            raise RuntimeError("rollup bug")
+
+    merger = _single_node_merger(collective_timeout_s=5)
+    merger.attach_hotspots(Exploding())
+    rng = np.random.default_rng(3)
+    h = rng.integers(0, 2**32, 16, dtype=np.uint64).astype(np.uint32)
+    merger.submit_window((h, h), np.ones(16, np.int32))
+    merger.merge_round()
+    assert merger.failed is None and not merger.degraded
+    assert merger.fleet_stats["fleet_rounds"] == 1
+    # And a degrade with an exploding store still degrades cleanly.
+    faults.install(faults.FaultInjector.from_spec(
+        "fleet.collective:error:count=1", seed=42))
+    merger.merge_round()
+    assert merger.degraded and merger.failed is None

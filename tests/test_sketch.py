@@ -1,4 +1,11 @@
-"""Sketch op tests: bounds, merges, host/device agreement."""
+"""Sketch op tests: bounds, merges, host/device agreement, and the
+cross-node merge-linearity properties the fleet rollups depend on
+(docs/hotspots.md): an N-way merge of per-node sketches must be
+elementwise-identical to a single-node build over the concatenated
+stream, using the same host/device-stable row hashes as the exact
+path."""
+
+import functools
 
 import numpy as np
 import pytest
@@ -6,6 +13,7 @@ import pytest
 from parca_agent_tpu.ops.sketch import (
     CountMinSpec,
     HLLSpec,
+    cm_add,
     cm_build,
     cm_merge,
     cm_query,
@@ -73,6 +81,86 @@ def test_hll_merge_is_union():
     merged = hll_merge(hll_build(a, spec), hll_build(b, spec))
     direct = hll_build(np.concatenate([a, b]), spec)
     assert np.array_equal(merged, direct)
+
+
+def _node_streams(n_nodes, rows_per_node, seed=0):
+    """Per-node (hash, count) streams keyed by the SAME row hashes the
+    exact path uses (ops/hashing.row_hash_np over synthetic stack rows),
+    with count-0 padding rows — the fleet wire shape. Nodes share stacks
+    (the same synthetic population sampled with different seeds), so the
+    merge genuinely deduplicates across nodes."""
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+    from parca_agent_tpu.ops.hashing import row_hash_np
+
+    streams = []
+    for node in range(n_nodes):
+        snap = generate(SyntheticSpec(
+            n_pids=4, n_unique_stacks=2 * rows_per_node,
+            n_rows=rows_per_node, total_samples=4 * rows_per_node,
+            mean_depth=6, seed=seed + node))
+        (h1,) = row_hash_np(snap.stacks, snap.pids, snap.user_len,
+                            snap.kernel_len, n_hashes=1)
+        counts = snap.counts.astype(np.int32)
+        # Pad to a fixed width with count-0 rows (merge identity).
+        pad = rows_per_node + 7
+        ph = np.zeros(pad, np.uint32)
+        pc = np.zeros(pad, np.int32)
+        ph[:len(h1)] = h1
+        pc[:len(counts)] = counts
+        streams.append((ph, pc))
+    return streams
+
+
+@pytest.mark.parametrize("n_nodes", [2, 8])
+def test_cm_nway_cross_node_merge_is_elementwise_identical(n_nodes):
+    """Property: reduce(cm_merge, per-node builds) == one build over the
+    concatenated stream — cell for cell, padding included. This is the
+    linearity fleet_merge_sketches' psum relies on, checked N-way (the
+    pairwise test alone would not catch an order- or width-dependent
+    bug)."""
+    spec = CountMinSpec(depth=4, width=1 << 10)
+    streams = _node_streams(n_nodes, 500, seed=10)
+    merged = functools.reduce(
+        cm_merge, (cm_build(h, c, spec) for h, c in streams))
+    all_h = np.concatenate([h for h, _ in streams])
+    all_c = np.concatenate([c for _, c in streams])
+    direct = cm_build(all_h, all_c, spec)
+    assert np.array_equal(merged, direct)
+    # Merge is order-independent (commutative + associative).
+    remerged = functools.reduce(
+        cm_merge, (cm_build(h, c, spec) for h, c in reversed(streams)))
+    assert np.array_equal(remerged, direct)
+    # And the streaming in-place accumulate agrees with both.
+    acc = np.zeros((spec.depth, spec.width), np.int64)
+    for h, c in streams:
+        cm_add(acc, h, c, spec)
+    assert np.array_equal(acc, direct)
+    # Point queries on the merged table never undercount the true
+    # cross-node totals.
+    uniq, inv = np.unique(all_h, return_inverse=True)
+    true = np.zeros(len(uniq), np.int64)
+    np.add.at(true, inv, all_c)
+    live = true > 0
+    est = cm_query(merged, uniq[live], spec).astype(np.int64)
+    assert np.all(est >= true[live])
+
+
+@pytest.mark.parametrize("n_nodes", [2, 8])
+def test_hll_nway_cross_node_max_merge_is_elementwise_identical(n_nodes):
+    """The HLL twin: idempotent register-max over N nodes == one build
+    over the concatenation (fleet_merge_sketches' pmax), with count-0
+    padding rows masked out via `live` exactly as the fleet program
+    masks dead nodes."""
+    spec = HLLSpec(p=10)
+    streams = _node_streams(n_nodes, 500, seed=20)
+    merged = functools.reduce(hll_merge, (
+        hll_build(h, spec, live=c > 0) for h, c in streams))
+    all_h = np.concatenate([h for h, _ in streams])
+    all_c = np.concatenate([c for _, c in streams])
+    direct = hll_build(all_h, spec, live=all_c > 0)
+    assert np.array_equal(merged, direct)
+    # Merging a stream with itself is a no-op (idempotence).
+    assert np.array_equal(hll_merge(merged, merged), merged)
 
 
 def test_hll_device_matches_host():
