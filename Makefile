@@ -4,12 +4,21 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-live chaos fuzz bench bench-statics bench-close bench-hotspot trace-smoke hotspot-smoke fixtures golden clean install
+.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot trace-smoke hotspot-smoke fixtures golden clean install
 
 all: native
 
 native:
 	$(MAKE) -C parca_agent_tpu/native
+
+# palint (docs/static-analysis.md): the AST-based invariant checker for
+# the agent's concurrency / fail-open / crash-only contracts — lock
+# discipline, fail-open hooks, crash-only IO, chaos-site coverage,
+# no-host-sync-on-capture, bounded-call. Runs in a few seconds; exits
+# non-zero on any finding not in tools/lint/baseline.json. `--json` for
+# machine-readable output.
+lint:
+	$(PYTHON) -m parca_agent_tpu.tools.lint
 
 # Everything that runs without perf_event permission (the reference's
 # `make test` analog, Makefile:207-214). The split is by the registered
@@ -24,8 +33,11 @@ test-live:
 
 # Fault-injection suite under a fixed seed (docs/robustness.md): store
 # outages, disk-full spill, actor crashes, device/fleet hangs —
-# deterministic by design, so it also rides every unmarked run.
-chaos:
+# deterministic by design, so it also rides every unmarked run. palint
+# preflights it: the chaos-site checker is what keeps this suite's
+# coverage honest (every SITES entry exercised here, and vice versa),
+# so drift fails fast before any test runs.
+chaos: lint
 	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):

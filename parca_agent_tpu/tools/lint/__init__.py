@@ -1,0 +1,48 @@
+"""palint: the agent's AST-based invariant checker.
+
+Every review round since PR 2 has hand-caught the same defect classes:
+fields read outside their guarding lock, hooks that must be fail-open
+but let an exception escape, persistent writes missing the tmp+rename
+discipline, chaos sites drifting out of the registry, host syncs creeping
+back onto the capture path, and hand-rolled copies of the abandonable
+bounded-call guard. The paper's always-on contract ("degrade, never
+die") depends on these invariants holding as the codebase grows — so
+they are machine-checked here, the way parca-agent leans on Go's race
+detector and `go vet` where Python gives us neither.
+
+Six project-specific checkers (docs/static-analysis.md):
+
+    lock-discipline   attributes annotated ``# guarded-by: _lock`` (or
+                      listed in a per-class ``_GUARDED`` map) may only be
+                      touched inside ``with self._lock`` in that class
+    fail-open-hook    functions registered as encode-pipeline
+                      snapshot/rollup hooks, supervisor probes, or
+                      annotated ``# palint: fail-open`` must wrap their
+                      body in a counted try/except that cannot re-raise
+    crash-only-io     write-mode opens in ``# palint: persistence-root``
+                      modules must flow through tmp + ``os.replace``
+    chaos-site        every ``inject("<site>")`` call site must match
+                      ``utils/faults.py``'s SITES registry and be
+                      exercised by a ``chaos``-marked test, and vice
+                      versa (no dead registry entries)
+    host-sync         functions reachable from a ``# palint:
+                      capture-path`` seed may not call blocking device
+                      fetches (``jax.device_get``, ``.block_until_
+                      ready()``, ``np.asarray``/``float``/``int`` over
+                      device state)
+    bounded-call      spawn-a-thread-then-``join(timeout)`` reimplements
+                      utils/bounded.py:bounded_call — use it instead
+
+Run via ``make lint`` or ``python -m parca_agent_tpu.tools.lint``
+(``--json`` for machine-readable output). Inline suppressions use
+``# palint: disable=<id>`` with a justification; pre-existing findings
+live in ``tools/lint/baseline.json`` so the gate fires on growth, not
+history (stale baseline entries are reported, never silently kept).
+"""
+
+from parca_agent_tpu.tools.lint.core import (  # noqa: F401
+    Finding,
+    Project,
+    SourceFile,
+    run_checkers,
+)

@@ -1,0 +1,7 @@
+"""``python -m parca_agent_tpu.tools.lint`` — see cli.py."""
+
+import sys
+
+from parca_agent_tpu.tools.lint.cli import main
+
+sys.exit(main())
