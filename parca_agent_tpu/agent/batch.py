@@ -88,7 +88,7 @@ class BatchWriteClient:
         self._consec_failures = 0
         self.sent_batches = 0
         self.send_errors = 0
-        self.stats = {
+        self.stats = {  # guarded-by: _lock
             "samples_dropped": 0,
             "bytes_dropped": 0,
             "overflow_spills": 0,
@@ -255,7 +255,11 @@ class BatchWriteClient:
                 if budget[0] <= 0 or self._clock() + delay >= deadline \
                         or self._stop.is_set():
                     if budget[0] <= 0:
-                        self.stats["retry_budget_exhausted"] += 1
+                        # Stats RMWs ride the lock everywhere (palint
+                        # lock-discipline): the capture/encode threads'
+                        # overflow path increments concurrently.
+                        with self._lock:
+                            self.stats["retry_budget_exhausted"] += 1
                     self._consec_failures += 1
                     if self._spool is not None and \
                             (drain or self._consec_failures
@@ -263,7 +267,8 @@ class BatchWriteClient:
                         batch_bytes = sum(
                             _series_bytes(s.labels, b)
                             for s in batch for b in s.samples)
-                        self.stats["failure_spills"] += 1
+                        with self._lock:
+                            self.stats["failure_spills"] += 1
                         self._spill(batch, batch_bytes,
                                     why="repeated flush failure"
                                     if not drain else "final drain")
@@ -304,15 +309,17 @@ class BatchWriteClient:
                 # the next interval (replay is at-least-once; the store
                 # dedups nothing, so a duplicate costs bytes, not
                 # correctness of the history).
-                self.stats["replay_errors"] += 1
+                with self._lock:
+                    self.stats["replay_errors"] += 1
                 _log.warn("spool replay failed; segment retained",
                           seq=seq, error=repr(e))
                 return
             self._spool.pop(seq)
             self._consec_failures = 0  # the store took data: recovered
-            self.stats["segments_replayed"] += 1
-            self.stats["samples_replayed"] += sum(
-                len(s.samples) for s in series)
+            with self._lock:
+                self.stats["segments_replayed"] += 1
+                self.stats["samples_replayed"] += sum(
+                    len(s.samples) for s in series)
             # One replayed segment end-to-end: decode + send + delete.
             window_trace.observe("spool_replay",
                                  time.perf_counter() - t_seg0)

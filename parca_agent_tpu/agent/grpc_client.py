@@ -159,8 +159,8 @@ class GRPCStoreClient:
         # re-attempted on the next RPC (the batch writer's backoff and
         # the debuginfo manager's error handling both absorb the raise).
         self._lock = threading.Lock()
-        self._channel_obj = None
-        self._write_raw_m = None
+        self._channel_obj = None   # guarded-by: _lock
+        self._write_raw_m = None   # guarded-by: _lock
         # Channel-reset policy (ADVICE round 5): skip-verify pins the
         # server certificate at first use, so a server cert rotation
         # makes every internal reconnect fail TLS until the channel is
@@ -176,8 +176,8 @@ class GRPCStoreClient:
         # _note_rpc_failure calls close(), which takes the channel lock —
         # sharing one would deadlock).
         self._stats_lock = threading.Lock()
-        self._consec_unavailable = 0
-        self.stats = {"channel_resets": 0}
+        self._consec_unavailable = 0            # guarded-by: _stats_lock
+        self.stats = {"channel_resets": 0}      # guarded-by: _stats_lock
 
     def _build_channel(self):
         grpc = self._grpc

@@ -49,6 +49,8 @@ from parca_agent_tpu.utils.vfs import atomic_write_bytes
 
 _log = get_logger("spool")
 
+# palint: persistence-root — the spool dir IS the crash-only pattern's home.
+
 _MAGIC = b"PASPOOL1"
 _HEADER = struct.Struct("<I")   # n_samples
 _FRAME = struct.Struct("<II")   # len, crc32
@@ -67,12 +69,12 @@ class SpoolDir:
         # unknowable across the monotonic-clock restart), so replay lag
         # counts from adoption — nonzero the moment a restart inherits a
         # backlog, which is exactly when the lag gauge matters most.
-        self._index: dict[int, tuple[int, int, float]] = {}
+        self._index: dict[int, tuple[int, int, float]] = {}  # guarded-by: _lock
         # Segments whose corruption has already been counted: a retained
         # partially-corrupt segment is re-read every replay attempt, and
         # its loss must be counted once, not once per attempt.
-        self._corrupt_counted: set[int] = set()
-        self.stats = {
+        self._corrupt_counted: set[int] = set()  # guarded-by: _lock
+        self.stats = {  # guarded-by: _lock
             "segments_written": 0,
             "bytes_written": 0,
             "segments_replayed": 0,
@@ -92,7 +94,15 @@ class SpoolDir:
 
     def _scan(self) -> None:
         """Adopt segments a previous process left behind (crash-only
-        recovery: whatever survived the rename barrier is replayable)."""
+        recovery: whatever survived the rename barrier is replayable).
+        Runs at construction only, but takes the (uncontended) lock
+        anyway: the index/stats discipline then holds unconditionally
+        (palint lock-discipline) instead of relying on "called before
+        the object is shared" staying true."""
+        with self._lock:
+            self._scan_locked()
+
+    def _scan_locked(self) -> None:  # palint: holds=_lock
         for name in sorted(os.listdir(self._dir)):
             path = os.path.join(self._dir, name)
             if name.endswith(".tmp"):
@@ -172,7 +182,7 @@ class SpoolDir:
         window_trace.observe("spool_spill", time.perf_counter() - t0)
         return True
 
-    def _evict_locked(self) -> None:
+    def _evict_locked(self) -> None:  # palint: holds=_lock
         while self._index and self._total_bytes_locked() > self._max_bytes:
             seq = min(self._index)
             size, n_samples, _ = self._index.pop(seq)
@@ -186,7 +196,7 @@ class SpoolDir:
             _log.warn("spool over byte cap; evicted oldest segment",
                       seq=seq, samples=n_samples)
 
-    def _total_bytes_locked(self) -> int:
+    def _total_bytes_locked(self) -> int:  # palint: holds=_lock
         return sum(size for size, _, _ in self._index.values())
 
     # -- replay side ---------------------------------------------------------

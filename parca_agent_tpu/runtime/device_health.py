@@ -135,20 +135,25 @@ class DeviceHealthRegistry:
 
         if start_state not in STATES:
             raise ValueError(f"unknown start state {start_state!r}")
-        self.state = start_state
-        self.windows = 0              # the window clock (tick_window)
-        self.trips = 0                # demotions + failed recoveries
-        self.cooldown_left = 0
-        self.consecutive_ok_probes = 0
-        self.shadow_pending = False
+        # The state machine below is mutated from the profiler thread
+        # (window clock), the probe-result callback thread, and read
+        # from the HTTP thread — everything rides _lock (palint
+        # lock-discipline; the _locked-suffix helpers are annotated
+        # holds=_lock).
+        self.state = start_state            # guarded-by: _lock
+        self.windows = 0                    # guarded-by: _lock
+        self.trips = 0                      # guarded-by: _lock
+        self.cooldown_left = 0              # guarded-by: _lock
+        self.consecutive_ok_probes = 0      # guarded-by: _lock
+        self.shadow_pending = False         # guarded-by: _lock
         self.wedged_at: int | None = None   # window of the last hang
         self.last_demote_window: int | None = None
         self.last_promote_window: int | None = None
         self.last_error: str = ""
-        self._consec_failures = 0
-        self._probe_gen = 0
-        self._probe_started_at: float | None = None
-        self.stats = {
+        self._consec_failures = 0                    # guarded-by: _lock
+        self._probe_gen = 0                          # guarded-by: _lock
+        self._probe_started_at: float | None = None  # guarded-by: _lock
+        self.stats = {  # guarded-by: _lock
             "probes_total": 0,
             "probes_ok": 0,
             "probes_failed": 0,   # == probes_total - probes_ok (invariant)
@@ -276,13 +281,15 @@ class DeviceHealthRegistry:
                 return
             if self._probe_started_at is None:
                 probe_needed = True
+                window = self.windows  # captured under the lock: the
+                #                        log below runs after release
                 self._launch_probe_locked()
         if probe_needed:
-            _log.debug("device re-probe launched", window=self.windows)
+            _log.debug("device re-probe launched", window=window)
 
     # -- probes --------------------------------------------------------------
 
-    def _launch_probe_locked(self) -> None:
+    def _launch_probe_locked(self) -> None:  # palint: holds=_lock
         self._probe_gen += 1
         self._probe_started_at = self._clock()
         self.stats["probes_total"] += 1
@@ -297,7 +304,7 @@ class DeviceHealthRegistry:
             ok, detail = False, repr(e)[:200]
         self._on_probe_result(gen, bool(ok), str(detail))
 
-    def _check_probe_deadline_locked(self) -> None:
+    def _check_probe_deadline_locked(self) -> None:  # palint: holds=_lock
         """A probe that outlived its deadline is a HANG: count it failed
         now and ignore its eventual result (generation bump). The probe
         subprocess bounds itself; this catches wedged spawns and
@@ -336,7 +343,7 @@ class DeviceHealthRegistry:
             self.stats["probes_failed"] += 1
             self._note_probe_failed_locked(detail)
 
-    def _note_probe_failed_locked(self, detail: str) -> None:
+    def _note_probe_failed_locked(self, detail: str) -> None:  # palint: holds=_lock
         self.consecutive_ok_probes = 0
         self.last_error = detail[:200]
         _log.warn("device probe failed", error=self.last_error,
@@ -345,7 +352,7 @@ class DeviceHealthRegistry:
 
     # -- transitions ---------------------------------------------------------
 
-    def _demote_locked(self, reason: str) -> None:
+    def _demote_locked(self, reason: str) -> None:  # palint: holds=_lock
         """One more trip: enter (or stay in) degraded with a doubled,
         capped cooldown; past the trip budget, dead."""
         self.trips += 1

@@ -366,7 +366,7 @@ class HotspotStore:
         self._fleet_last_at: float | None = None
         self._fleet_degraded = False
         self.last_fleet_error = ""
-        self.stats = {
+        self.stats = {  # guarded-by: _lock
             "windows_folded": 0,
             "fold_errors": 0,
             "last_fold_s": 0.0,
@@ -394,7 +394,11 @@ class HotspotStore:
         try:
             self._fold_from(agg, idx, vals, time_ns, duration_ns)
         except Exception:
-            self.stats["fold_errors"] += 1
+            # Under the lock (palint lock-discipline): the HTTP thread's
+            # count_query_error and the fleet actor's degrade counter
+            # mutate the same dict concurrently.
+            with self._lock:
+                self.stats["fold_errors"] += 1
             raise
 
     def _fold_from(self, agg, idx, vals, time_ns: int,
@@ -431,7 +435,8 @@ class HotspotStore:
             h1[idx], h2[idx], np.asarray(vals, np.int64), ctx_for,
             self.spec, time_ns, duration_ns)
         self.fold(s)
-        self.stats["last_fold_s"] = time.perf_counter() - t0
+        with self._lock:
+            self.stats["last_fold_s"] = time.perf_counter() - t0
 
     def fold(self, s: WindowSummary) -> None:
         """Fold one node-local window summary into the level hierarchy

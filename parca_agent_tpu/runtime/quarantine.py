@@ -94,8 +94,8 @@ class QuarantineRegistry:
         self.deadline_s = deadline_s
         self._clock = clock
         self._lock = threading.Lock()
-        self._pids: dict[int, _PidState] = {}
-        self.stats = {
+        self._pids: dict[int, _PidState] = {}  # guarded-by: _lock
+        self.stats = {  # guarded-by: _lock
             "errors_total": 0,
             "deadline_trips_total": 0,
             "trips_total": 0,
@@ -154,7 +154,7 @@ class QuarantineRegistry:
             self.stats["deadline_trips_total"] += 1
         return level
 
-    def _evict_one_locked(self) -> bool:
+    def _evict_one_locked(self) -> bool:  # palint: holds=_lock
         """Make room at the tracked-pid cap: evict the least-incriminated
         non-quarantined entry (fewest trips, then strikes, oldest first),
         so a churn of one-error pids can never flush a persistently
@@ -264,7 +264,7 @@ class QuarantineRegistry:
             if salvaged:
                 self.stats["windows_salvaged_total"] += 1
 
-    def _trip(self, st: _PidState, pid: int) -> None:
+    def _trip(self, st: _PidState, pid: int) -> None:  # palint: holds=_lock
         # Lock held by caller.
         st.trips += 1
         st.state = "quarantined"
@@ -285,7 +285,7 @@ class QuarantineRegistry:
         with self._lock:
             return self._counts_locked()
 
-    def _counts_locked(self) -> dict[str, int]:
+    def _counts_locked(self) -> dict[str, int]:  # palint: holds=_lock
         out = {"quarantined": 0, "probation": 0, "watched": 0,
                "level_addresses": 0, "level_scalar": 0}
         for st in self._pids.values():

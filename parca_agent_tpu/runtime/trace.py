@@ -53,6 +53,8 @@ from parca_agent_tpu.utils.vfs import atomic_write_bytes
 
 _log = get_logger("trace")
 
+# palint: persistence-root — incident files are read by operators post-crash.
+
 # Log-spaced bucket upper bounds in seconds: 10 us doubling to ~671 s.
 # 27 finite buckets + the implicit +Inf bucket cover everything from a
 # sub-ms host-side stage to the >420 s device hangs on record.
@@ -217,6 +219,7 @@ class WindowTrace:
     def span(self, stage: str) -> _SpanCtx:
         return _SpanCtx(self, stage)
 
+    # palint: fail-open
     def add_span(self, stage: str, duration_s: float,
                  error: str | None = None,
                  histogram: bool = True) -> None:
@@ -240,6 +243,7 @@ class WindowTrace:
         except Exception as e:  # noqa: BLE001 - tracing is fail-open
             self._rec._record_error(e)
 
+    # palint: fail-open
     def annotate(self, **kv) -> None:
         try:
             # Rebind, don't mutate: a detached trace may already be in
@@ -314,9 +318,14 @@ class FlightRecorder:
                  max_incidents: int = 64, self_profile_s: float = 1.0,
                  context=None, self_profile=None, clock=time.monotonic):
         self._lock = threading.Lock()
-        self._ring: collections.deque = collections.deque(maxlen=max(1, ring))
-        self._hists: dict[str, StageHistogram] = {}
-        self._seq = 0
+        # guarded-by: _lock (the next three + stats below): profiler
+        # thread, encode worker, batch/flush threads, and the HTTP read
+        # side all meet here — the PR 7 review round's two-writer
+        # lost-update is exactly what the annotation now machine-checks.
+        self._ring: collections.deque = collections.deque(  # guarded-by: _lock
+            maxlen=max(1, ring))
+        self._hists: dict[str, StageHistogram] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
         self._slow_multiple = slow_multiple
         self._min_count = max(1, min_count)
         self._min_duration = min_duration_s
@@ -331,7 +340,7 @@ class FlightRecorder:
         self._self_profile_s = self_profile_s
         if incident_dir:
             os.makedirs(incident_dir, exist_ok=True)
-        self.stats = {
+        self.stats = {  # guarded-by: _lock
             "traces_started": 0,
             "traces_completed": 0,
             "traces_discarded": 0,
@@ -351,6 +360,7 @@ class FlightRecorder:
 
     # -- trace lifecycle -----------------------------------------------------
 
+    # palint: fail-open
     def begin(self, time_ns: int | None = None):
         """Start the next window's trace. Fail-open: any internal error
         returns the NULL trace so the window proceeds untraced."""
@@ -367,6 +377,7 @@ class FlightRecorder:
             self._record_error(e)
             return NULL_TRACE
 
+    # palint: fail-open
     def complete(self, trace: WindowTrace, error: str | None = None) -> None:
         """Finish a trace: total span, ring append, histogram feed, slow
         detection. Idempotent; fail-open."""
@@ -417,6 +428,7 @@ class FlightRecorder:
         except Exception as e:  # noqa: BLE001 - tracing is fail-open
             self._record_error(e)
 
+    # palint: fail-open
     def discard(self, trace) -> None:
         """Drop a trace that never became a window (source exhausted):
         not ringed, not histogrammed."""
@@ -428,6 +440,7 @@ class FlightRecorder:
         except Exception as e:  # noqa: BLE001 - tracing is fail-open
             self._record_error(e)
 
+    # palint: fail-open
     def observe(self, stage: str, duration_s: float) -> None:
         """Feed one non-per-window stage observation (batch flush, store
         ack, spool spill/replay) into its histogram + the slow detector.
@@ -457,7 +470,7 @@ class FlightRecorder:
 
     # -- slow-window detection / incidents -----------------------------------
 
-    def _budget_locked(self, stage: str) -> float | None:
+    def _budget_locked(self, stage: str) -> float | None:  # palint: holds=_lock
         """Stage budget = slow_multiple x running p99, floored at
         min_duration_s; None until min_count samples exist (a budget
         computed from two observations is noise, not a contract)."""
