@@ -72,22 +72,49 @@ class DiscoveryManager:
     def alive(self) -> bool:
         """True while every started provider thread is still running (a
         provider that raised died silently before; the supervisor's probe
-        surfaces and heals it)."""
-        return all(t.is_alive() for t in self._threads)
+        surfaces and heals it). Fail-open supervisor probe (palint
+        fail-open-hook): an exception here reads as unhealthy, never as
+        a dead poll loop."""
+        try:
+            # Snapshot first: restart_dead (the revive hook) mutates the
+            # list, and "list changed size during iteration" out of a
+            # health probe would be self-harm.
+            return all(t.is_alive() for t in list(self._threads))
+        except Exception:  # noqa: BLE001 - probe contract: never raise
+            self.failed_updates += 1
+            return False
 
     def restart_dead(self) -> int:
         """Respawn provider threads that died (the supervisor's revive
-        hook). Returns how many were restarted."""
-        if self._stop.is_set():
+        hook). Returns how many were restarted. Fail-open: a respawn
+        failure (thread limits, a provider constructor raising) is
+        counted and retried at the next probe tick."""
+        try:
+            if self._stop.is_set():
+                return 0
+            restarted = 0
+            for t in [t for t in self._threads if not t.is_alive()]:
+                # Per-provider containment: one spawn failure (thread
+                # limits) must not abort the remaining respawns or
+                # discard the count of those already restarted. Spawn
+                # FIRST, drop the dead entry only on success: a failed
+                # spawn leaves the corpse in _threads so alive() stays
+                # False and the next probe tick retries — removing
+                # first would read as healthy with the provider
+                # silently gone.
+                try:
+                    name = t.name.removeprefix("discovery-")
+                    p = self._providers.get(name)
+                    if p is not None:
+                        self._spawn(name, p)
+                        restarted += 1
+                    self._threads.remove(t)
+                except Exception:  # noqa: BLE001 - probe contract
+                    self.failed_updates += 1
+            return restarted
+        except Exception:  # noqa: BLE001 - probe contract: never raise
+            self.failed_updates += 1
             return 0
-        dead = [t for t in self._threads if not t.is_alive()]
-        for t in dead:
-            self._threads.remove(t)
-            name = t.name.removeprefix("discovery-")
-            p = self._providers.get(name)
-            if p is not None:
-                self._spawn(name, p)
-        return len(dead)
 
     def _run_provider(self, name: str, p: Discoverer) -> None:
         def up(groups: list[Group]) -> None:

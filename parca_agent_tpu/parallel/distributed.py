@@ -457,21 +457,32 @@ class FleetWindowMerger:
         """Supervisor probe hook: False when the fleet schedule looks
         stalled — an in-flight round older than its bound (with a
         collective timeout configured a round cannot stall, so this only
-        trips on the unbounded config) or fleet mode terminally failed."""
-        if self.failed is not None:
+        trips on the unbounded config) or fleet mode terminally failed.
+        Fail-open (palint fail-open-hook): a probe that raises reads as
+        unhealthy, never as a dead poll loop."""
+        try:
+            if self.failed is not None:
+                return False
+            started = self.round_started_at
+            if started is None:
+                return True
+            bound = max(self._interval,
+                        self._collective_timeout or 0.0) * 2 \
+                + self._interval
+            return self._clock() - started <= bound
+        except Exception as e:  # noqa: BLE001 - probe contract
+            log.warn("fleet heartbeat probe failed", error=repr(e)[:200])
             return False
-        started = self.round_started_at
-        if started is None:
-            return True
-        bound = max(self._interval,
-                    self._collective_timeout or 0.0) * 2 + self._interval
-        return self._clock() - started <= bound
 
     def request_rejoin(self) -> None:
         """Supervisor revive hook: pull the next rejoin probe forward to
-        the next round."""
-        if self.degraded:
-            self._rejoin_in = min(self._rejoin_in, 1)
+        the next round. Fail-open: a revive that raises would read as a
+        revive failure and burn a crash-budget strike over bookkeeping."""
+        try:
+            if self.degraded:
+                self._rejoin_in = min(self._rejoin_in, 1)
+        except Exception as e:  # noqa: BLE001 - revive contract
+            log.warn("rejoin request failed", error=repr(e)[:200])
 
     def run(self, stop) -> None:
         """Actor loop (threading.Event stop)."""

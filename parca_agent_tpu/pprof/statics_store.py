@@ -72,6 +72,8 @@ from parca_agent_tpu.utils.vfs import atomic_write_bytes
 
 _log = get_logger("statics-store")
 
+# palint: persistence-root — the warm statics snapshot is adopted at startup.
+
 _MAGIC = b"PASTATS1"
 _FMARK = b"PSRC"                       # per-frame marker (resync anchor)
 _FRAME = struct.Struct("<II")          # payload len, crc32(payload)
@@ -153,43 +155,52 @@ class StaticsStore:
         same concurrent-reader contract build_statics uses, so a feed
         landing on the profiler thread mid-save can only make the
         snapshot slightly behind — never torn. False (counted) when the
-        write fails; the agent carries on, one snapshot poorer."""
+        write fails; the agent carries on, one snapshot poorer. The
+        WHOLE body rides the counted try (palint fail-open-hook): this
+        runs as an EncodePipeline snapshot hook, and an exception from
+        the skip-check's stat() would otherwise read as an encoder death
+        and disable the pipeline over a disk hiccup."""
         import numpy as np
 
-        t0 = time.perf_counter()
-        # Clean skip: nothing mutated any registry since the last save
-        # (same version/epoch/period), so the file on disk is already
-        # byte-equivalent — the common steady state, where re-serializing
-        # every pid each interval would keep the encode worker busy for
-        # seconds and push the NEXT window into submit() backpressure.
-        state = (getattr(agg, "_reg_version", None),
-                 getattr(agg, "registry_epoch", 0), int(period_ns))
-        # _last_saved records the state only when the encoder was FULLY
-        # built at write time (see below), so matching it means the file
-        # on disk carries complete statics for exactly this content — a
-        # later encoder reset cannot invalidate it (content unchanged).
-        if state[0] is not None and state == self._last_saved \
-                and os.path.exists(self.path):
-            try:
-                # The skip VERIFIED the on-disk content is current, so
-                # refresh the file's mtime as the liveness signal —
-                # otherwise a long stationary run would let the header
-                # timestamp rot past --statics-snapshot-max-age and the
-                # next restart would reject a perfectly current snapshot
-                # as stale (adoption ages by max(header, mtime)).
-                now = self._clock()
-                os.utime(self.path, times=(now, now))
-            except OSError:
-                pass
-            self.stats["snapshots_skipped_clean"] += 1
-            return "skipped"  # truthy: the on-disk snapshot IS current
-        # Whether the encoder's statics are provably complete at this
-        # version (its clean marker): only then may this save's state be
-        # recorded for future skips — else a straggler pid whose statics
-        # finish after this write would stay registry-only forever.
-        enc_clean = (encoder is None or getattr(
-            encoder, "_statics_clean", None) == (state[0], int(period_ns)))
         try:
+            t0 = time.perf_counter()
+            # Clean skip: nothing mutated any registry since the last
+            # save (same version/epoch/period), so the file on disk is
+            # already byte-equivalent — the common steady state, where
+            # re-serializing every pid each interval would keep the
+            # encode worker busy for seconds and push the NEXT window
+            # into submit() backpressure.
+            state = (getattr(agg, "_reg_version", None),
+                     getattr(agg, "registry_epoch", 0), int(period_ns))
+            # _last_saved records the state only when the encoder was
+            # FULLY built at write time (see below), so matching it
+            # means the file on disk carries complete statics for
+            # exactly this content — a later encoder reset cannot
+            # invalidate it (content unchanged).
+            if state[0] is not None and state == self._last_saved \
+                    and os.path.exists(self.path):
+                try:
+                    # The skip VERIFIED the on-disk content is current,
+                    # so refresh the file's mtime as the liveness signal
+                    # — otherwise a long stationary run would let the
+                    # header timestamp rot past
+                    # --statics-snapshot-max-age and the next restart
+                    # would reject a perfectly current snapshot as stale
+                    # (adoption ages by max(header, mtime)).
+                    now = self._clock()
+                    os.utime(self.path, times=(now, now))
+                except OSError:
+                    pass
+                self.stats["snapshots_skipped_clean"] += 1
+                return "skipped"  # truthy: the on-disk snapshot IS current
+            # Whether the encoder's statics are provably complete at
+            # this version (its clean marker): only then may this save's
+            # state be recorded for future skips — else a straggler pid
+            # whose statics finish after this write would stay
+            # registry-only forever.
+            enc_clean = (encoder is None or getattr(
+                encoder, "_statics_clean", None)
+                == (state[0], int(period_ns)))
             faults.inject("statics.snapshot")
             body = bytearray(_MAGIC)
 
@@ -291,6 +302,14 @@ class StaticsStore:
                 _frame(bytes(rec))
                 n_records += 1
             atomic_write_bytes(self.path, bytes(body))
+            self._last_saved = state if enc_clean else None
+            self.stats["snapshots_written"] += 1
+            self.stats["snapshot_bytes"] = len(body)
+            self.stats["snapshot_records"] = n_records
+            self.stats["records_dropped_cap"] += dropped
+            self.stats["snapshot_save_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3)
+            return True
         except Exception as e:  # noqa: BLE001 - a snapshot may fail for
             # any reason (disk, injected chaos, a serialization surprise)
             # and must always degrade to "no snapshot this interval",
@@ -300,14 +319,6 @@ class StaticsStore:
             _log.warn("statics snapshot write failed; skipping",
                       error=repr(e))
             return False
-        self._last_saved = state if enc_clean else None
-        self.stats["snapshots_written"] += 1
-        self.stats["snapshot_bytes"] = len(body)
-        self.stats["snapshot_records"] = n_records
-        self.stats["records_dropped_cap"] += dropped
-        self.stats["snapshot_save_ms"] = round(
-            (time.perf_counter() - t0) * 1e3, 3)
-        return True
 
     # -- read side (startup) -------------------------------------------------
 

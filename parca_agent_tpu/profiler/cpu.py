@@ -582,6 +582,9 @@ class CPUProfiler:
         with self._write_mu:
             return self._labels_for(pid)
 
+    # palint: fail-open=caller — the pipeline's hand-off guard counts
+    # rollup_errors and ships the window unfolded; swallowing here would
+    # leave that exported counter dark.
     def _rollup_capture(self, prep):
         """EncodePipeline rollup-capture hook (PROFILER thread, at window
         hand-off): snapshot the per-id mirror references the fold will
@@ -590,6 +593,9 @@ class CPUProfiler:
 
         return RegistryView(self._aggregator)
 
+    # palint: fail-open=caller — fold_from_aggregator counts fold_errors
+    # and RE-RAISES by contract, for the pipeline's worker guard to
+    # count rollup_errors; both counters are exported on /metrics.
     def _rollup_window(self, prep, ctx) -> None:
         """EncodePipeline rollup hook (worker thread): fold the shipped
         window's live (id, count) rows into the hotspot store, reading

@@ -283,16 +283,19 @@ class EncodePipeline:
         probe-revive hook): clear the disabled latch so the next submit()
         restarts the worker thread. The encoder's mirrors were already
         reset by _fail_window; ``reset=True`` forces another reset for
-        callers reviving after external encoder surgery."""
-        if reset:
-            try:
+        callers reviving after external encoder surgery. Fail-open
+        (palint fail-open-hook): a revive that raises reads as a revive
+        failure to the supervisor — count and stay disabled instead."""
+        try:
+            if reset:
                 self._enc.reset()
-            except Exception as e:  # noqa: BLE001 - best-effort
-                _log.warn("encoder reset failed during revive",
-                          error=repr(e))
-        self.disabled = False
-        self.last_error = None
-        _log.info("encode pipeline revived")
+            self.disabled = False
+            self.last_error = None
+            _log.info("encode pipeline revived")
+        except Exception as e:  # noqa: BLE001 - revive contract
+            _log.warn("encoder reset failed during revive; pipeline "
+                      "stays disabled until the next probe tick",
+                      error=repr(e))
 
     def _do_window(self, prep, rollup_ctx, fallback,
                    trace=NULL_TRACE) -> None:

@@ -124,6 +124,37 @@ def test_failed_provider_counted():
     mgr.stop()
 
 
+def test_restart_dead_spawn_failure_keeps_probe_unhealthy():
+    """The revive hook is fail-open, but a failed respawn must NOT eat
+    the dead thread's corpse: alive() has to stay False so the
+    supervisor's next probe tick retries, instead of reading healthy
+    with the provider silently gone."""
+    mgr = DiscoveryManager()
+
+    class Once:
+        def run(self, stop, up):
+            return  # exits immediately: thread dies clean
+
+    mgr.apply_config({"once": Once()})
+    mgr.run()
+    deadline = time.monotonic() + 5
+    while mgr.alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not mgr.alive()
+
+    def boom_spawn(name, p):
+        raise RuntimeError("no threads left")
+
+    real_spawn, mgr._spawn = mgr._spawn, boom_spawn
+    assert mgr.restart_dead() == 0          # fail-open: swallowed+counted
+    assert mgr.failed_updates == 1
+    assert not mgr.alive()                  # corpse retained: still dead
+    mgr._spawn = real_spawn
+    assert mgr.restart_dead() == 1          # next tick's retry succeeds
+    assert len(mgr._threads) == 1           # corpse swapped for the respawn
+    mgr.stop()
+
+
 def test_end_to_end_discovery_to_labels():
     """Discovery groups flow into the ServiceDiscoveryProvider and out
     through the labels manager (reference call stack section 3.5)."""
