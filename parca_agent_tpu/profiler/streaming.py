@@ -190,6 +190,9 @@ class StreamingWindowFeeder:
 
     # -- drain tee (called inside sampler.poll on the profiler thread) -------
 
+    # palint: capture-path — runs synchronously inside the sampler's
+    # poll() on the profiler thread; feed work here must be dispatch-
+    # only (the aggregator's seeded feed carries the same contract).
     def on_drain(self, cols) -> None:
         if self.disabled:
             return
@@ -287,31 +290,25 @@ class StreamingWindowFeeder:
             self._window_feed_s += time.perf_counter() - t_feed0
 
     def _feed_guarded(self, mini: WindowSnapshot) -> bool:
-        box: dict = {}
-        done = threading.Event()
+        """One feed under the shared abandonable guard (utils/
+        bounded.py — palint bounded-call: this was the last hand-rolled
+        copy of the spawn/join/abandon dance PR 5 unified)."""
+        from parca_agent_tpu.utils.bounded import bounded_call
 
-        def call():
-            try:
-                self._agg.feed(mini)
-                box["ok"] = True
-            except BaseException as e:  # noqa: BLE001 - surfaced below
-                box["err"] = e
-            finally:
-                done.set()
-
-        threading.Thread(target=call, name="stream-feed",
-                         daemon=True).start()
         timeout = self._first_timeout if not self._first_attempted \
             else self._timeout
         self._first_attempted = True
-        if not done.wait(timeout):
+        status, out, done, _box = bounded_call(
+            lambda: self._agg.feed(mini), timeout,
+            thread_name="stream-feed")
+        if status == "hang":
             # Abandoned: the call may still be mutating the aggregator.
             self._inflight = done
             _log.error("streaming feed hung; abandoning",
                        timeout_s=timeout)
             return False
-        if "err" in box:
-            _log.warn("streaming feed error", error=repr(box["err"]))
+        if status == "err":
+            _log.warn("streaming feed error", error=repr(out))
             return False
         return True
 
