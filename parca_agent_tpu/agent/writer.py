@@ -25,6 +25,8 @@ import time
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.vfs import atomic_write_bytes
 
+# palint: persistence-root — local profile store writes survive restarts.
+
 
 def _series_filename(labels: dict[str, str], now_ns: int) -> str:
     parts = [f"{k}={labels[k]}" for k in sorted(labels)

@@ -53,6 +53,11 @@ from typing import BinaryIO, Sequence
 
 import numpy as np
 
+from parca_agent_tpu.utils.vfs import atomic_write_bytes
+
+# palint: persistence-root — snapshot fixture files are replay/bench
+# inputs adopted across process restarts; writes must be tmp+rename.
+
 # Reference caps stacks at 127 frames (bpf/cpu/cpu.bpf.c:22-27). We pad the
 # frame axis to 128 so a stack row is exactly one TPU lane-width vector.
 MAX_STACK_DEPTH = 127
@@ -328,12 +333,15 @@ def save_snapshot(snap: WindowSnapshot, path_or_file) -> None:
     _write_strs(payload, mt.obj_buildids)
 
     compressed = zlib.compress(payload.getvalue(), 6)
+    blob = _MAGIC + _VERSION.to_bytes(4, "little") + compressed
     if hasattr(path_or_file, "write"):
-        out = path_or_file
-        out.write(_MAGIC + _VERSION.to_bytes(4, "little") + compressed)
+        path_or_file.write(blob)
     else:
-        with open(path_or_file, "wb") as out:
-            out.write(_MAGIC + _VERSION.to_bytes(4, "little") + compressed)
+        # Crash-atomic (palint crash-only-io): a torn snapshot file
+        # reads as "bad magic"/short payload at the next load — tmp +
+        # rename means the path either holds the old fixture or the
+        # complete new one, never a half.
+        atomic_write_bytes(path_or_file, blob)
 
 
 def load_snapshot(path_or_file) -> WindowSnapshot:

@@ -12,6 +12,8 @@ import io
 import os
 from typing import Iterable, Protocol
 
+# palint: persistence-root — atomic_write_bytes is the shared tmp+rename primitive.
+
 
 class VFS(Protocol):
     def read_bytes(self, path: str) -> bytes: ...
@@ -105,12 +107,12 @@ class ErrorFS:
     read_bytes = exists = listdir = open = stat_signature = _raise
 
 
-def atomic_write_bytes(path: str, data: bytes) -> None:
+def atomic_write_bytes(path: str | os.PathLike, data: bytes) -> None:
     """Crash-atomic file write: tmp sibling + os.replace, tmp cleaned on
     failure. Readers of `path` only ever see a whole file (the
     local-store profile writer and the spill spool both depend on this
     — a crash mid-write must never leave a truncated artifact)."""
-    tmp = path + ".tmp"
+    tmp = os.fspath(path) + ".tmp"
     try:
         with open(tmp, "wb") as f:
             f.write(data)
