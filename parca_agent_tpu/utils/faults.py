@@ -24,6 +24,10 @@ layer the ship-path components consult at NAMED SITES:
     incident.dump     the slow-window incident writer — an injected
                       fault costs the incident file (incidents_failed),
                       never the window
+    hotspot.fold      one window's fold into the hotspot rollup store
+                      (runtime/hotspots.py) — fail-open like tracing:
+                      an injected fault is counted (fold_errors) and
+                      costs query freshness, never the window
 
 and, on the ingest side (docs/robustness.md "ingest containment" — the
 ``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an
@@ -87,6 +91,37 @@ from parca_agent_tpu.utils.log import get_logger
 from parca_agent_tpu.utils.poison import PoisonInput
 
 _log = get_logger("faults")
+
+
+# The machine-readable site registry: the contract between the inject()
+# call sites, the chaos-marked tests, and palint's chaos-site checker
+# (tools/lint/chaos_sites.py), which enforces that the three agree —
+# every call site documented here, every entry injected somewhere, and
+# every entry exercised by at least one test under the `chaos` marker.
+# The docstring above narrates the same list; THIS is the source of
+# truth a checker can read. Wildcard entries ("actor.*") match by
+# prefix, mirroring FaultRule.matches.
+SITES = {
+    "grpc.write_raw": "the WriteRaw RPC (agent/grpc_client.py)",
+    "grpc.handshake": "channel construction (agent/grpc_client.py)",
+    "spool.write": "spill-segment write (agent/spool.py)",
+    "writer.write": "local-store profile write (agent/writer.py)",
+    "batch.flush": "one flush attempt (agent/batch.py)",
+    "actor.*": "a supervised actor's loop tick (runtime/supervisor.py)",
+    "statics.snapshot": "warm statics snapshot (pprof/statics_store.py)",
+    "trace.record": "flight-recorder entry points (runtime/trace.py)",
+    "incident.dump": "slow-window incident writer (runtime/trace.py)",
+    "hotspot.fold": "hotspot rollup fold (runtime/hotspots.py)",
+    "elf.read": "ElfFile construction (elf/reader.py)",
+    "perfmap.parse": "JIT perf-map read+parse (symbolize/perfmap.py)",
+    "maps.parse": "/proc/<pid>/maps parse (process/maps.py)",
+    "symbolize.kernel": "batched kallsyms resolve (symbolize/ksym.py)",
+    "unwind.build": "one mapping's unwind table (unwind/table.py)",
+    "device.probe": "backend bring-up probe (runtime/device_health.py)",
+    "device.dispatch": "guarded device aggregation (profiler/cpu.py)",
+    "fleet.join": "jax.distributed fleet join (parallel/distributed.py)",
+    "fleet.collective": "one fleet merge/re-probe collective round",
+}
 
 
 class InjectedFault(Exception):

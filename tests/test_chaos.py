@@ -474,6 +474,50 @@ def test_grpc_stats_race_free_under_concurrent_failures():
     assert client._consec_unavailable == total % 5
 
 
+def test_grpc_handshake_fault_absorbed_by_batch_flush(monkeypatch):
+    """The ``grpc.handshake`` chaos site fires inside the REAL
+    _build_channel (channel construction): an injected handshake-class
+    failure there must be absorbed by the batch writer's flush/restore
+    machinery — a transiently un-dialable store costs a failed flush
+    and a retry next interval, never an agent crash — and the next
+    flush after the fault clears rebuilds the channel and ships the
+    restored batch."""
+    pytest.importorskip("grpc")
+    from parca_agent_tpu.agent.batch import BatchWriteClient
+    from parca_agent_tpu.agent.grpc_client import GRPCStoreClient
+
+    shipped = []
+
+    class FakeChannel:
+        def unary_unary(self, *a, **kw):
+            return (lambda req, timeout=None, metadata=None:
+                    shipped.append(req) or b"")
+
+        def close(self):
+            pass
+
+    class FakeGrpc:
+        """Stands in for the grpc module BEHIND the handshake site, so
+        the real _build_channel (and its inject call) still runs but no
+        network dial happens."""
+
+        def insecure_channel(self, addr, options=None):
+            return FakeChannel()
+
+    client = GRPCStoreClient("store.test:443", insecure=True)
+    client._grpc = FakeGrpc()
+    batch = BatchWriteClient(client, retry_budget=0)
+    faults.install(FaultInjector.from_spec(
+        "grpc.handshake:handshake:count=2", seed=0))
+    batch.write_raw({"a": "1"}, b"x")
+    assert batch.flush() is False      # injected handshake: absorbed
+    assert batch.flush() is False      # still down; batch restored
+    assert batch.send_errors == 2 and batch.buffered() == (1, 1)
+    assert shipped == []
+    assert batch.flush() is True       # fault count exhausted: rebuilt
+    assert len(shipped) == 1 and batch.buffered() == (0, 0)
+
+
 # -- file writer ---------------------------------------------------------------
 
 

@@ -199,3 +199,50 @@ def test_fleet_rollup_failure_never_breaks_the_merge_schedule():
         "fleet.collective:error:count=1", seed=42))
     merger.merge_round()
     assert merger.degraded and merger.failed is None
+
+
+def test_injected_fold_fault_costs_freshness_never_a_window():
+    """The ``hotspot.fold`` chaos site (utils/faults.py SITES): an
+    injected fault inside the fold is counted on the store
+    (fold_errors, its exported contract) AND contained by the encode
+    worker (rollup_errors) — the faulted windows still ship, later
+    windows still fold, and the agent never sees the exception."""
+    store = HotspotStore(
+        spec=HotspotSpec(k=5, candidates=256,
+                         cm=CountMinSpec(depth=3, width=1 << 8)),
+        window_s=10.0)
+    snaps = [_snap(i) for i in range(4)]
+
+    class Src:
+        def __init__(self):
+            self.snaps = list(snaps)
+
+        def poll(self):
+            return self.snaps.pop(0) if self.snaps else None
+
+    prof = CPUProfiler(
+        source=Src(), aggregator=DictAggregator(capacity=1 << 12),
+        fallback_aggregator=CPUAggregator(), profile_writer=_Sink(),
+        duration_s=0.0, fast_encode=True, encode_pipeline=True,
+        hotspot_store=store)
+    faults.install(faults.FaultInjector.from_spec(
+        "hotspot.fold:error:count=2", seed=42))
+    try:
+        while prof.run_iteration():
+            assert prof._pipeline.flush(30)
+        assert prof._pipeline.quiesce(30)
+    finally:
+        prof._pipeline.close(10)
+    # Both layers of the fail-open contract counted (the fold re-raises
+    # by design — palint fail-open=caller — and the worker contains it).
+    assert store.stats["fold_errors"] == 2
+    assert prof._pipeline.stats["rollup_errors"] == 2
+    # No window was lost or left unshipped; the non-faulted windows
+    # still folded into the rollups.
+    assert prof._pipeline.stats["windows_lost"] == 0
+    assert prof._pipeline.stats["windows_pipelined"] == len(snaps)
+    assert prof._pipeline.stats["windows_rolled"] == len(snaps) - 2
+    assert store.stats["windows_folded"] == len(snaps) - 2
+    assert prof.metrics.errors_total == 0
+    # The store still answers from the windows that did fold.
+    assert store.query(k=5)["entries"]
