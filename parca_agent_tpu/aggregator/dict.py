@@ -638,6 +638,11 @@ class DictAggregator:
     # bpf/cpu/cpu.bpf.c:110-116), and window close only packs + fetches the
     # accumulated counts. window_counts() remains the one-shot batch path.
 
+    # palint: capture-path — the feed is the capture thread's dispatch-
+    # only hot path (docs/perf.md "sub-RTT close"): device work must
+    # OVERLAP capture, so no host sync may ride here. Device state for
+    # the checker (one line — the grammar does not parse continuations):
+    # palint: device-state: _dev, _acc, _touch, _acc_spare, _touch_spare
     def feed(self, snapshot: WindowSnapshot, hashes=None,
              lo: int = 0, hi: int | None = None) -> None:
         """Accumulate snapshot rows [lo, hi) into the open window."""
@@ -711,6 +716,9 @@ class DictAggregator:
         self.timings["feed_dispatch"] = _time.perf_counter() - t0
         self._miss_inflight = (handle, packed, snapshot, lo, h1, h2, h3)
 
+    # palint: sync-ok — THE deferred sync boundary: by the next feed (or
+    # the close) the kernel has completed, so this is a completion
+    # check, not the kernel-latency stall the old inline sync paid.
     def _settle_misses(self) -> None:
         """Settle the deferred miss check of the last dispatched feed:
         sync the miss count, and resolve any misses (insert new stacks,
@@ -808,6 +816,8 @@ class DictAggregator:
         self._touch = touch if self._blk else None
         return (n_miss, miss_rows)
 
+    # palint: sync-ok — reached only through _settle_misses (same
+    # boundary); int(n_miss) IS the documented sync point.
     def _settle_dispatch(self, handle) -> np.ndarray:
         """Sync one dispatched feed's miss outputs; returns chunk-relative
         miss row indices (empty in steady state)."""
@@ -867,6 +877,9 @@ class DictAggregator:
         hold the view longer transfers ownership via pin_counts()."""
         return self.close_collect(self.close_dispatch(), copy=copy)
 
+    # palint: capture-path — dispatch half of the split close: pack
+    # kernel launch + buffer flip only; the fetch belongs to
+    # close_collect, off this path.
     def close_dispatch(self) -> "_CloseHandle | None":
         """First half of the window close: settle deferred feed misses,
         dispatch the pack kernel against the open accumulator (no host

@@ -293,6 +293,10 @@ class ShardedDictAggregator(DictAggregator):
             out[s, 4, : len(mine)] = mine.astype(np.uint32)
         return out
 
+    # palint: capture-path — the sharded override of the dispatch-only
+    # feed (the base seed's call graph stops at file scope, so the
+    # override seeds itself). Device state (one line, no continuations):
+    # palint: device-state: _dev, _acc, _touch, _acc_spare, _touch_spare
     def _feed_dispatch_async(self, packed: np.ndarray, n_pad: int,
                              reset: int):
         import jax
@@ -311,6 +315,7 @@ class ShardedDictAggregator(DictAggregator):
         self._acc = acc
         return (n_miss, miss_rows)
 
+    # palint: sync-ok — the sharded twin of the base settle boundary.
     def _settle_dispatch(self, handle) -> np.ndarray:
         n_miss, miss_rows = handle
         per_shard = np.asarray(n_miss)  # device sync point
