@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot trace-smoke hotspot-smoke fixtures golden clean install
+.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks trace-smoke hotspot-smoke fixtures golden clean install
 
 all: native
 
@@ -38,7 +38,7 @@ test-live:
 # coverage honest (every SITES entry exercised here, and vice versa),
 # so drift fails fast before any test runs.
 chaos: lint
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the
@@ -78,6 +78,13 @@ trace-smoke:
 # per-level byte caps held with oldest-eviction engaged. Numpy-only.
 bench-hotspot:
 	JAX_PLATFORMS=cpu PARCA_BENCH_HOTSPOT_CHILD=1 $(PYTHON) bench.py
+
+# Output-backend sink drill (docs/sinks.md): the sha256 pprof-identity
+# bar through the SinkRegistry vs the legacy direct ship, per-sink emit
+# latency, autofdo flush bytes, and the injected-sink-fault zero-loss
+# acceptance check. Host-bound, so it pins the cpu backend.
+bench-sinks:
+	JAX_PLATFORMS=cpu PARCA_BENCH_SINK_CHILD=1 $(PYTHON) bench.py
 
 # Hotspot end-to-end smoke (docs/hotspots.md): a short real profiler
 # session (dict aggregator, encode pipeline) must serve human-readable

@@ -1820,6 +1820,160 @@ def _hotspot_query() -> dict:
     return phase
 
 
+def _sink_fanout() -> dict:
+    """`make bench-sinks`: the output-backend subsystem's acceptance
+    drill (docs/sinks.md), host-bound and deterministic.
+
+    A synthetic window stream runs through the REAL encode pipeline
+    three times:
+
+      * arm A (legacy): the pre-sink direct ship — sha256 of every
+        shipped pprof byte is the identity baseline;
+      * arm B (registry): pprof + autofdo + series sinks behind the
+        SinkRegistry — the pprof sha256 MUST equal arm A's (the
+        acceptance bar), with per-sink emit latency and the autofdo
+        flush byte volume reported;
+      * arm C (chaos): an injected ``sink.emit`` fault in the autofdo
+        backend — the pprof ship must not lose a window
+        (``windows_lost == 0``) and the fault must be counted.
+    """
+    import shutil
+    import tempfile
+
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+    from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+    from parca_agent_tpu.profiler.encode_pipeline import EncodePipeline
+    from parca_agent_tpu.runtime.hotspots import RegistryView
+    from parca_agent_tpu.sinks import (
+        AutoFDOSink,
+        PprofSink,
+        SeriesSink,
+        SinkRegistry,
+    )
+    from parca_agent_tpu.utils import faults as faults_mod
+
+    windows = int(os.environ.get("PARCA_BENCH_SINK_WINDOWS", 12))
+    rows = int(os.environ.get("PARCA_BENCH_SINK_ROWS", 4000))
+    n_pids = int(os.environ.get("PARCA_BENCH_SINK_PIDS", 200))
+    snaps = [generate(SyntheticSpec(
+        n_pids=n_pids, n_unique_stacks=rows, n_rows=rows,
+        total_samples=rows * 4, mean_depth=12, kernel_fraction=0.2,
+        seed=w + 1)) for w in range(windows)]
+
+    def run_arm(registry=None):
+        agg = DictAggregator(capacity=1 << max(14, (4 * rows).bit_length()))
+        sha = hashlib.sha256()
+        shipped = [0]
+
+        def hash_out(out):
+            for _, b in out:
+                sha.update(bytes(b))
+            shipped[0] += 1
+
+        if registry is not None:
+            registry.bind(ship=hash_out)
+            pipe = EncodePipeline(
+                WindowEncoder(agg),
+                ship=lambda out, prep: registry.emit_window(out, prep),
+                sink_capture=lambda prep: RegistryView(agg))
+        else:
+            pipe = EncodePipeline(WindowEncoder(agg),
+                                  ship=lambda out, prep: hash_out(out))
+        emit_ms: dict[str, list] = {}
+        for s in snaps:
+            counts = np.asarray(agg.window_counts(s))
+            assert pipe.submit(counts, s.time_ns, s.window_ns,
+                               s.period_ns) is not None
+            assert pipe.flush(60)
+            if registry is not None:
+                for name, st in registry.metrics().items():
+                    if name != "_registry":
+                        emit_ms.setdefault(name, []).append(
+                            st["last_emit_s"] * 1e3)
+        assert pipe.close()
+        if registry is not None:
+            registry.close()
+        return sha.hexdigest(), shipped[0], pipe, emit_ms
+
+    # Arm A: legacy direct ship.
+    t0 = time.perf_counter()
+    sha_legacy, shipped_legacy, _, _ = run_arm()
+    legacy_s = time.perf_counter() - t0
+
+    # Arm B: the full sink registry.
+    afdo_dir = tempfile.mkdtemp(prefix="bench-afdo-")
+    try:
+        afdo = AutoFDOSink(afdo_dir, flush_windows=4)
+        series = SeriesSink(labels_for=lambda pid: {"pid": str(pid)})
+        reg = SinkRegistry([PprofSink(), afdo, series])
+        t0 = time.perf_counter()
+        sha_sink, shipped_sink, pipe_b, emit_ms = run_arm(reg)
+        sink_s = time.perf_counter() - t0
+        reg_m = reg.metrics()
+        afdo_files = len([f for f in os.listdir(afdo_dir)
+                          if f.endswith(".afdo.txt")])
+    finally:
+        shutil.rmtree(afdo_dir, ignore_errors=True)
+
+    # Arm C: injected autofdo emit fault; pprof must lose nothing.
+    faults_mod.install(faults_mod.FaultInjector.from_spec(
+        "sink.emit:error:count=2", seed=42))
+    try:
+        chaos_dir = tempfile.mkdtemp(prefix="bench-afdo-chaos-")
+        try:
+            reg_c = SinkRegistry([PprofSink(),
+                                  AutoFDOSink(chaos_dir, flush_windows=4)])
+            sha_chaos, _, pipe_c, _ = run_arm(reg_c)
+            chaos_m = reg_c.metrics()
+        finally:
+            shutil.rmtree(chaos_dir, ignore_errors=True)
+    finally:
+        faults_mod.install(None)
+
+    identical = sha_sink == sha_legacy
+    chaos_identical = sha_chaos == sha_legacy
+
+    phase = {
+        "windows": windows,
+        "rows": rows,
+        "pids": n_pids,
+        "bytes_identical": identical,
+        "sha256": sha_legacy[:16],
+        "legacy_wall_s": round(legacy_s, 3),
+        "sink_wall_s": round(sink_s, 3),
+        "emit_ms_median": {name: round(_median_ms([v / 1e3 for v in ms]), 3)
+                           for name, ms in emit_ms.items()},
+        "emit_ms_max": {name: round(max(ms), 3)
+                        for name, ms in emit_ms.items()},
+        "autofdo_flush_bytes": reg_m["autofdo"]["bytes"],
+        "autofdo_files": afdo_files,
+        "autofdo_samples": reg_m["autofdo"]["samples"],
+        "series_sets": reg_m["series"]["sets"],
+        "sink_errors": sum(st.get("errors", 0)
+                           for n, st in reg_m.items() if n != "_registry"),
+        "chaos_bytes_identical": chaos_identical,
+        "chaos_windows_lost": pipe_c.stats["windows_lost"],
+        "chaos_sink_errors": chaos_m["autofdo"]["errors"],
+        "chaos_pprof_windows": chaos_m["pprof"]["windows"],
+        "windows_lost": pipe_b.stats["windows_lost"],
+    }
+    if not identical:
+        phase["error"] = ("pprof bytes through the sink registry differ "
+                          "from the legacy ship path")
+    elif pipe_b.stats["windows_lost"] or pipe_c.stats["windows_lost"]:
+        phase["error"] = "a sink arm lost a window"
+    elif not chaos_identical or chaos_m["pprof"]["windows"] != windows:
+        phase["error"] = ("the injected sink.emit fault disturbed the "
+                          "pprof ship")
+    elif chaos_m["autofdo"]["errors"] != 2:
+        phase["error"] = ("the injected sink.emit faults were not "
+                          "counted as sink errors")
+    elif reg_m["autofdo"]["bytes"] <= 0:
+        phase["error"] = "the autofdo sink flushed no profdata bytes"
+    return phase
+
+
 def _finalize_result(result: dict, device_alive: bool,
                      probe_log: list | None = None,
                      attempt_hung: bool = False,
@@ -1944,6 +2098,21 @@ def _close_main() -> None:
     print(json.dumps({"metric": "close_overlap", **phase}))
 
 
+def _sink_main() -> None:
+    """`make bench-sinks`: the output-backend fan-out drill alone, one
+    JSON line. Host-bound (pipeline + sinks are pure host work)."""
+    try:
+        phase = _sink_fanout()
+    except Exception as e:  # noqa: BLE001 - the line must still print
+        phase = {"error": repr(e)[:300]}
+    import jax
+
+    phase["backend"] = jax.default_backend()
+    _finalize_result(phase, device_alive=True,
+                     require_full_scale=False, require_device=False)
+    print(json.dumps({"metric": "sink_fanout", **phase}))
+
+
 def _hotspot_main() -> None:
     """`make bench-hotspot`: the hotspot rollup drill alone, one JSON
     line. Numpy-only — the backend stamp just records the pin."""
@@ -1985,6 +2154,9 @@ def main() -> None:
         return
     if os.environ.get("PARCA_BENCH_HOTSPOT_CHILD"):
         _hotspot_main()
+        return
+    if os.environ.get("PARCA_BENCH_SINK_CHILD"):
+        _sink_main()
         return
     if os.environ.get("PARCA_BENCH_PROBE_CHILD"):
         _probe_main()

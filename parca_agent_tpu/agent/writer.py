@@ -64,3 +64,19 @@ class RemoteProfileWriter:
     def write(self, labels: dict[str, str],
               pprof_bytes: bytes | memoryview) -> None:
         self._sink.write_raw(labels, gzip.compress(pprof_bytes, 1))
+
+
+class TeeProfileWriter:
+    """Fan one profile write to several writers (--local-store-directory
+    plus the remote path). Arms are constructed ONCE, here — the old CLI
+    closure built a fresh RemoteProfileWriter per write. A failing arm
+    aborts the remaining arms, like the single-writer path: the caller's
+    per-profile error handling owns the failure either way."""
+
+    def __init__(self, *writers):
+        self._writers = writers
+
+    def write(self, labels: dict[str, str],
+              pprof_bytes: bytes | memoryview) -> None:
+        for w in self._writers:
+            w.write(labels, pprof_bytes)

@@ -235,6 +235,39 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hotspot-stale-after", type=float, default=60.0,
                    help="seconds without a completed fleet merge round "
                         "before fleet-scope answers are flagged stale")
+    p.add_argument("--sink", default="pprof",
+                   help="comma-separated output backends for shipped "
+                        "windows (docs/sinks.md): pprof (the store ship "
+                        "path; always required), autofdo (per-binary "
+                        "LLVM profdata-text PGO profiles keyed by "
+                        "build-id, --autofdo-* flags), series (scalar "
+                        "OTLP-style per-label-set sample-count series "
+                        "on /metrics). Secondary sinks are fail-open: "
+                        "their failures are counted and can never "
+                        "delay or drop the pprof ship. autofdo/series "
+                        "need --fast-encode")
+    p.add_argument("--autofdo-dir", default="",
+                   help="directory for the AutoFDO sink's per-binary "
+                        "profdata-text profiles (<build-id>.afdo.txt, "
+                        "crash-only tmp+rename rewrites; adopted on "
+                        "restart so counts accumulate without replay). "
+                        "Required when --sink includes autofdo")
+    p.add_argument("--autofdo-flush-windows", type=int, default=6,
+                   help="shipped windows between AutoFDO profile "
+                        "rewrites (the PGO freshness/IO trade; each "
+                        "flush atomically rewrites only dirty binaries)")
+    p.add_argument("--autofdo-max-binaries", type=int, default=256,
+                   help="bounded-memory cap on per-build-id AutoFDO "
+                        "accumulators; samples past it are dropped and "
+                        "counted")
+    p.add_argument("--autofdo-max-offsets", type=int, default=65536,
+                   help="distinct leaf offsets kept per binary; samples "
+                        "at new offsets past it are dropped and counted "
+                        "(hot offsets were admitted first)")
+    p.add_argument("--series-max-sets", type=int, default=4096,
+                   help="label sets kept by the series sink; past it "
+                        "the least-recently-updated series is evicted "
+                        "(counted)")
     p.add_argument("--streaming-window", action="store_true",
                    help="feed each capture drain to the aggregation device "
                         "DURING the window (perf capture + dict aggregator "
@@ -392,7 +425,11 @@ def run(argv=None) -> int:
 
     from parca_agent_tpu.agent.batch import BatchWriteClient, NoopStoreClient
     from parca_agent_tpu.agent.listener import MatchingProfileListener
-    from parca_agent_tpu.agent.writer import FileProfileWriter, RemoteProfileWriter
+    from parca_agent_tpu.agent.writer import (
+        FileProfileWriter,
+        RemoteProfileWriter,
+        TeeProfileWriter,
+    )
     from parca_agent_tpu.aggregator.cpu import CPUAggregator
     from parca_agent_tpu.config import ConfigReloader, load_config_file
     from parca_agent_tpu.debuginfo.manager import DebuginfoManager
@@ -569,14 +606,11 @@ def run(argv=None) -> int:
         replay_per_interval=args.spool_replay_per_interval)
     listener = MatchingProfileListener(next_writer=batch)
     if args.local_store_directory:
-        file_writer = FileProfileWriter(args.local_store_directory)
-
-        class Tee:
-            def write(self, labels, pprof_bytes):
-                file_writer.write(labels, pprof_bytes)
-                RemoteProfileWriter(listener).write(labels, pprof_bytes)
-
-        writer = Tee()
+        # Both tee arms built once (the remote arm used to be
+        # reconstructed inside every write call).
+        writer = TeeProfileWriter(
+            FileProfileWriter(args.local_store_directory),
+            RemoteProfileWriter(listener))
     else:
         writer = RemoteProfileWriter(listener)
 
@@ -797,6 +831,49 @@ def run(argv=None) -> int:
                 raise SystemExit(f"bad --hotspot-* flags: {e}")
             if fleet_merger is not None:
                 fleet_merger.attach_hotspots(hotspot_store)
+
+    # -- output-backend sinks (docs/sinks.md) --------------------------------
+    # --sink pprof,autofdo,series: each shipped window fans out to every
+    # configured backend; pprof is the primary ship path (byte-identical
+    # to the pre-sink writer route) and the secondaries are fail-open.
+    sink_names = [s.strip() for s in args.sink.split(",") if s.strip()]
+    unknown = [s for s in sink_names if s not in ("pprof", "autofdo",
+                                                  "series")]
+    if unknown:
+        raise SystemExit(f"unknown --sink backend(s) {unknown!r} "
+                         "(want pprof, autofdo, series)")
+    if "pprof" not in sink_names:
+        raise SystemExit("--sink must include pprof: it is the agent's "
+                         "ship path (secondaries ride beside it)")
+    secondary_names = [s for s in dict.fromkeys(sink_names)
+                       if s != "pprof"]
+    if secondary_names and not args.fast_encode:
+        log.warn("--sink autofdo/series need --fast-encode (sinks read "
+                 "prepared windows); secondary sinks disabled")
+        secondary_names = []
+    sink_registry = None
+    if secondary_names:
+        from parca_agent_tpu.sinks import (
+            AutoFDOSink,
+            PprofSink,
+            SeriesSink,
+            SinkRegistry,
+        )
+
+        sink_list = [PprofSink()]
+        if "autofdo" in secondary_names:
+            if not args.autofdo_dir:
+                raise SystemExit("--sink autofdo needs --autofdo-dir")
+            if args.autofdo_flush_windows < 1:
+                raise SystemExit("--autofdo-flush-windows must be >= 1")
+            sink_list.append(AutoFDOSink(
+                args.autofdo_dir,
+                flush_windows=args.autofdo_flush_windows,
+                max_binaries=args.autofdo_max_binaries,
+                max_offsets=args.autofdo_max_offsets))
+        if "series" in secondary_names:
+            sink_list.append(SeriesSink(max_sets=args.series_max_sets))
+        sink_registry = SinkRegistry(sink_list)
     profiler = CPUProfiler(
         source=source,
         aggregator=aggregator,
@@ -824,6 +901,7 @@ def run(argv=None) -> int:
         statics_cache_bytes=args.statics_cache_bytes,
         trace_recorder=recorder,
         hotspot_store=hotspot_store,
+        sinks=sink_registry,
     )
 
     if statics_store is not None and profiler._encoder is not None:
@@ -948,7 +1026,8 @@ def run(argv=None) -> int:
                            device_health=device_health,
                            statics_store=statics_store,
                            recorder=recorder,
-                           hotspots=hotspot_store)
+                           hotspots=hotspot_store,
+                           sinks=sink_registry)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None

@@ -343,7 +343,7 @@ class _PreparedWindow:
     bytes of this one."""
 
     __slots__ = ("idx", "vals", "pids_live", "time_ns", "duration_ns",
-                 "period_ns", "rotations", "caps")
+                 "period_ns", "rotations", "caps", "sink_ctx")
 
     def __init__(self, idx, vals, pids_live, time_ns, duration_ns,
                  period_ns, rotations, caps):
@@ -355,6 +355,12 @@ class _PreparedWindow:
         self.period_ns = period_ns
         self.rotations = rotations
         self.caps = caps
+        # Output-backend context (sinks/): a rotation-consistent
+        # RegistryView captured on the profiler thread at hand-off, so
+        # secondary sinks can read per-id frame mirrors on the encode
+        # worker without racing cold-stack rotation. None until (and
+        # unless) a sink capture hook fills it.
+        self.sink_ctx = None
 
 
 def _reg_cap(reg) -> tuple:
@@ -456,6 +462,14 @@ class WindowEncoder:
             "last_statics_build_s": 0.0,
             "statics_build_s_total": 0.0,
         }
+        # Last inline-encoded prepared window, stashed by encode() ONLY
+        # when a consumer opted in (track_prep — the profiler sets it
+        # when secondary sinks are bound): the prepared arrays are
+        # MB-scale at large row counts and must not outlive the window
+        # for callers with no sink fan-out. Pipelined windows travel as
+        # preps directly and never ride this.
+        self.track_prep = False
+        self.last_prep = None
 
     # -- content cache -------------------------------------------------------
 
@@ -536,6 +550,7 @@ class WindowEncoder:
         self._static.clear()
         self._statics_clean = None
         self._tmpl = _Template()
+        self.last_prep = None
 
     def _ensure_order(self) -> None:
         """Rebuild the id-by-pid sort order if stale. Lazy and separate
@@ -1446,9 +1461,15 @@ class WindowEncoder:
         valid only until the next encode() call; for callers (bench, batch
         writer) that consume within the window.
         """
-        return self.encode_prepared(
-            self.prepare(counts, time_ns, duration_ns, period_ns),
-            views=views)
+        prep = self.prepare(counts, time_ns, duration_ns, period_ns)
+        if self.track_prep:
+            # Stashed for the inline sink fan-out (profiler/cpu.py):
+            # after a successful inline encode the secondary sinks
+            # consume the same prepared rows the pprof bytes came from.
+            # One window deep by construction — the next encode
+            # replaces it.
+            self.last_prep = prep
+        return self.encode_prepared(prep, views=views)
 
     def encode_prepared(self, prep: _PreparedWindow,
                         views: bool = False) -> list[tuple[int, bytes]]:

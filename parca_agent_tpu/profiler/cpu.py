@@ -91,6 +91,7 @@ class CPUProfiler:
         statics_cache_bytes: int = 256 << 20,
         trace_recorder=None,
         hotspot_store=None,
+        sinks=None,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -151,6 +152,27 @@ class CPUProfiler:
         if hotspot_store is not None and labels_manager is not None \
                 and hotspot_store.labels_for is None:
             hotspot_store.labels_for = self._locked_labels_for
+        # Output-backend sinks (sinks/, docs/sinks.md): the registry
+        # replaces the hardwired pprof ship with a fan-out whose primary
+        # (pprof) IS the pre-sink write path bound below — bytes stay
+        # identical — and whose secondaries (autofdo/series) consume the
+        # prepared window under the counted fail-open contract. Pipelined
+        # windows fan out on the encode worker (emit_window); inline-
+        # fallback windows fan out on this thread (_emit_sinks_inline);
+        # scalar-path windows are counted as skipped — no prepared rows
+        # exist for a sink to read.
+        self._sinks = sinks
+        if sinks is not None:
+            if self._encoder is None:
+                raise ValueError("sinks require fast_encode (the sink "
+                                 "fan-out reads prepared windows)")
+            sinks.bind(ship=self._write_encoded,
+                       labels_for=(self._locked_labels_for
+                                   if labels_manager is not None else None))
+            # Opt the encoder into the inline-path prep stash only when
+            # someone will read it — without secondaries it would just
+            # pin each window's prepared arrays for nothing.
+            self._encoder.track_prep = sinks.has_secondary
         if encode_pipeline:
             if self._encoder is None:
                 raise ValueError("encode_pipeline requires fast_encode")
@@ -170,7 +192,13 @@ class CPUProfiler:
                 rollup=(self._rollup_window
                         if hotspot_store is not None else None),
                 rollup_capture=(self._rollup_capture
-                                if hotspot_store is not None else None))
+                                if hotspot_store is not None else None),
+                # The sink context is the same rotation-consistent
+                # RegistryView the rollup capture produces; reusing the
+                # hook keeps one definition of "safe to read off-thread".
+                sink_capture=(self._rollup_capture
+                              if sinks is not None
+                              and sinks.has_secondary else None))
         else:
             if statics_store is not None:
                 _log.warn("statics snapshotting needs the encode pipeline; "
@@ -645,8 +673,15 @@ class CPUProfiler:
         return n
 
     def _ship_encoded(self, out, prep) -> None:
-        """EncodePipeline ship hook (worker thread)."""
-        self._write_encoded(out)
+        """EncodePipeline ship hook (worker thread): with sinks
+        configured, the registry runs the primary pprof ship (the same
+        _write_encoded bound at construction — identical bytes) and
+        fans the window out to the secondaries; a secondary failure is
+        counted there and never reaches the pipeline's ship guard."""
+        if self._sinks is not None:
+            self._sinks.emit_window(out, prep)
+        else:
+            self._write_encoded(out)
         if self._pipeline is not None:
             self.metrics.last_encode_duration_s = \
                 self._pipeline.stats["last_encode_s"]
@@ -655,10 +690,42 @@ class CPUProfiler:
         """Aggregate + write one window through the scalar path (the
         encode fallback: pipeline backpressure, encoder exceptions, or a
         blown inline deadline)."""
+        if self._sinks is not None:
+            # No prepared window exists on this path; sinks (secondaries
+            # included) cannot see it — counted, so PGO/series coverage
+            # gaps during fallback storms are observable.
+            self._sinks.count_skipped()
         profiles = self._fallback.aggregate(snapshot)
         for prof in profiles:
             self._write_profile(prof)
         return len(profiles)
+
+    # palint: fail-open
+    def _emit_sinks_inline(self, out, snapshot: WindowSnapshot) -> None:
+        """Secondary-sink fan-out for an INLINE-encoded window (profiler
+        thread: no pipeline, pipeline disabled, or hand-off refused).
+        The pprof bytes already shipped through _write_encoded; here the
+        secondaries consume the same prepared rows, with a registry view
+        captured on this thread — the thread that runs rotation, so the
+        capture cannot race it. Fail-open: a sink bug costs sinks one
+        window, never the iteration."""
+        try:
+            if self._sinks is None or not self._sinks.has_secondary:
+                return
+            prep = getattr(self._encoder, "last_prep", None)
+            if prep is None or prep.time_ns != snapshot.time_ns:
+                # The encoder did not stash THIS window (e.g. a custom
+                # encode path): skip rather than misattribute.
+                self._sinks.count_skipped()
+                return
+            from parca_agent_tpu.runtime.hotspots import RegistryView
+
+            prep.sink_ctx = RegistryView(self._aggregator)
+            self._sinks.emit_secondary(out, prep)
+        except Exception as e:  # noqa: BLE001 - sinks are best-effort
+            self._sinks.count_capture_error()
+            _log.warn("inline sink fan-out failed; window skipped for "
+                      "secondary sinks", error=repr(e))
 
     def _aggregate_encode_write(self, snapshot: WindowSnapshot,
                                 tr=NULL_TRACE) -> int:
@@ -758,8 +825,17 @@ class CPUProfiler:
                     self._write_profile(prof)
             return len(out)
         tr.annotate(path="inline")
-        with tr.span("ship"):
-            return self._write_encoded(out)
+        try:
+            with tr.span("ship"):
+                n = self._write_encoded(out)
+        finally:
+            # Secondaries run even when the pprof write raised (the
+            # iteration guard upstream owns that error): a store outage
+            # must not starve the PGO loop — the same try/finally the
+            # pipelined route's registry fan-out uses.
+            if self._sinks is not None:
+                self._emit_sinks_inline(out, snapshot)
+        return n
 
     def _submit_to_pipeline(self, counts, snapshot: WindowSnapshot,
                             tr=NULL_TRACE) -> int | None:
@@ -906,6 +982,11 @@ class CPUProfiler:
                 # Clean shutdown flushes the in-flight window: everything
                 # aggregated gets shipped before the actor exits.
                 self._pipeline.close()
+            if self.crashed is None and self._sinks is not None:
+                # After the pipeline drained: the sink close is the
+                # AutoFDO accumulator's final crash-only flush, so a
+                # clean shutdown persists the partial flush interval.
+                self._sinks.close()
             self._restore_gc()
 
     crashed: BaseException | None = None

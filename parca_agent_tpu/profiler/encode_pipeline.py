@@ -60,11 +60,19 @@ class EncodePipeline:
     def __init__(self, encoder, ship, ship_views: bool = True,
                  name: str = THREAD_NAME, snapshot=None,
                  snapshot_every: int = 0, rollup=None,
-                 rollup_capture=None):
+                 rollup_capture=None, sink_capture=None):
         self._enc = encoder
         self._ship = ship
         self._views = ship_views
         self._name = name
+        # Output-backend capture hook (sinks/): `sink_capture(prep)` runs
+        # on the PROFILER thread at hand-off and its result rides the
+        # prepared window as `prep.sink_ctx` — the rotation-consistent
+        # registry view the secondary sinks read on this worker during
+        # the ship fan-out. Best-effort: a capture failure is counted
+        # and the window ships with sink_ctx=None (frame-reading sinks
+        # skip it, the pprof ship is unaffected).
+        self._sink_capture = sink_capture
         # Hotspot rollup hook (runtime/hotspots.py): a `rollup(prep, ctx)`
         # callable run on THIS worker thread after every shipped window.
         # `ctx` is whatever `rollup_capture(prep)` returned on the
@@ -111,6 +119,7 @@ class EncodePipeline:
             "windows_rolled": 0,
             "rollup_errors": 0,
             "last_rollup_s": 0.0,
+            "sink_capture_errors": 0,
         }
 
     # -- profiler-thread API -------------------------------------------------
@@ -150,16 +159,34 @@ class EncodePipeline:
                 self._cond.notify_all()
             raise
         trace.detach()
+        if self._sink_capture is not None:
+            # Still the profiler thread (rotation cannot interleave):
+            # the captured view brackets the prepared ids exactly, same
+            # reasoning as the rollup capture below.
+            try:
+                prep.sink_ctx = self._sink_capture(prep)
+            except Exception as e:  # noqa: BLE001 - sinks are best-effort
+                self.stats["sink_capture_errors"] += 1
+                _log.warn("sink context capture failed; secondary sinks "
+                          "skip this window", error=repr(e))
         rollup_ctx = None
         if self._rollup is not None and self._rollup_capture is not None:
-            # Still the profiler thread: rotation cannot interleave, so
-            # the captured view brackets the prepared ids exactly.
-            try:
-                rollup_ctx = self._rollup_capture(prep)
-            except Exception as e:  # noqa: BLE001 - rollup is best-effort
-                self.stats["rollup_errors"] += 1
-                _log.warn("hotspot rollup capture failed; window will "
-                          "ship unfolded", error=repr(e))
+            if self._rollup_capture is self._sink_capture \
+                    and prep.sink_ctx is not None:
+                # The profiler registers the SAME capture hook for both
+                # consumers (one definition of "safe to read
+                # off-thread"): reuse the view captured above instead of
+                # building an identical one on the hand-off path.
+                rollup_ctx = prep.sink_ctx
+            else:
+                # Still the profiler thread: rotation cannot interleave,
+                # so the captured view brackets the prepared ids exactly.
+                try:
+                    rollup_ctx = self._rollup_capture(prep)
+                except Exception as e:  # noqa: BLE001 - best-effort
+                    self.stats["rollup_errors"] += 1
+                    _log.warn("hotspot rollup capture failed; window "
+                              "will ship unfolded", error=repr(e))
         with self._cond:
             # Enqueue and unpark in ONE lock acquisition: clearing
             # _handoff first would let a pending prebuild slip in ahead

@@ -28,6 +28,15 @@ layer the ship-path components consult at NAMED SITES:
                       (runtime/hotspots.py) — fail-open like tracing:
                       an injected fault is counted (fold_errors) and
                       costs query freshness, never the window
+    sink.emit         one secondary output-backend's per-window emit
+                      (sinks/registry.py) — fail-open by contract: an
+                      injected fault is counted (the sink's errors
+                      stat) and costs that sink one window, never the
+                      pprof ship (docs/sinks.md)
+    sink.flush        one AutoFDO profdata file's crash-only rewrite
+                      (sinks/autofdo.py; disk_full/error — counted
+                      flush_errors, the file stays dirty and is
+                      retried at the next flush cadence)
 
 and, on the ingest side (docs/robustness.md "ingest containment" — the
 ``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an
@@ -112,6 +121,8 @@ SITES = {
     "trace.record": "flight-recorder entry points (runtime/trace.py)",
     "incident.dump": "slow-window incident writer (runtime/trace.py)",
     "hotspot.fold": "hotspot rollup fold (runtime/hotspots.py)",
+    "sink.emit": "secondary output-backend emit (sinks/registry.py)",
+    "sink.flush": "AutoFDO profdata crash-only rewrite (sinks/autofdo.py)",
     "elf.read": "ElfFile construction (elf/reader.py)",
     "perfmap.parse": "JIT perf-map read+parse (symbolize/perfmap.py)",
     "maps.parse": "/proc/<pid>/maps parse (process/maps.py)",

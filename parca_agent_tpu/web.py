@@ -133,7 +133,7 @@ class _MetricsBuffer:
 def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    supervisor=None, quarantine=None,
                    device_health=None, statics_store=None,
-                   recorder=None, hotspots=None) -> str:
+                   recorder=None, hotspots=None, sinks=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics and
     the window flight recorder's stage histograms
@@ -346,6 +346,36 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
         emit("parca_agent_hotspot_fleet_stale", int(m["stale"]))
         if "fleet_age_s" in m:
             emit("parca_agent_hotspot_fleet_age_seconds", m["fleet_age_s"])
+    if sinks is not None:
+        # Output-backend sinks (docs/sinks.md): the contract trio —
+        # windows/bytes/errors per sink — as labeled families, every
+        # backend-specific stat under its own family, plus the series
+        # sink's per-label-set cumulative sample counts (the OTLP-style
+        # scalar series the sink exists to serve).
+        m = sinks.metrics()
+        reg = m.pop("_registry", {})
+        for name, st in sorted(m.items()):
+            lab = {"sink": name}
+            emit("parca_agent_sink_windows_total", st.pop("windows", 0),
+                 lab)
+            emit("parca_agent_sink_errors_total", st.pop("errors", 0),
+                 lab)
+            emit("parca_agent_sink_bytes_total", st.pop("bytes", 0), lab)
+            emit("parca_agent_sink_last_emit_seconds",
+                 round(st.pop("last_emit_s", 0.0), 6), lab)
+            for k, v in sorted(st.items()):
+                if isinstance(v, (int, float)):
+                    emit(f"parca_agent_sink_{k}",
+                         round(v, 6) if isinstance(v, float) else v, lab)
+        emit("parca_agent_sink_windows_skipped_total",
+             reg.get("windows_skipped", 0))
+        emit("parca_agent_sink_capture_errors_total",
+             reg.get("capture_errors", 0))
+        series_sink = sinks.sink("series")
+        if series_sink is not None:
+            for pt in series_sink.series():
+                buf.sample("parca_agent_sink_series_samples_total", "",
+                           pt["labels"], pt["value"], mtype="counter")
     for k, v in (extra or {}).items():
         # Extra metrics may arrive with pre-rendered labels
         # ("name{k=\"v\"}"): split so the family still gets its TYPE
@@ -362,7 +392,7 @@ class AgentHTTPServer:
                  version: str = "dev", extra_metrics=None,
                  capture_info=None, supervisor=None, quarantine=None,
                  device_health=None, statics_store=None, recorder=None,
-                 hotspots=None):
+                 hotspots=None, sinks=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -392,7 +422,8 @@ class AgentHTTPServer:
                         device_health=outer.device_health,
                         statics_store=outer.statics_store,
                         recorder=outer.recorder,
-                        hotspots=outer.hotspots).encode())
+                        hotspots=outer.hotspots,
+                        sinks=outer.sinks).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
@@ -506,6 +537,8 @@ class AgentHTTPServer:
                            if outer.statics_store is not None else None)
                 hotspots = (outer.hotspots.snapshot()
                             if outer.hotspots is not None else None)
+                sinks = (outer.sinks.snapshot()
+                         if outer.sinks is not None else None)
                 if outer.supervisor is None:
                     body = {"status": "healthy", "actors": {}}
                     if quarantine is not None:
@@ -516,6 +549,8 @@ class AgentHTTPServer:
                         body["statics"] = statics
                     if hotspots is not None:
                         body["hotspots"] = hotspots
+                    if sinks is not None:
+                        body["sinks"] = sinks
                     self._send(200, json.dumps(body).encode(),
                                "application/json")
                     return
@@ -546,6 +581,13 @@ class AgentHTTPServer:
                     # answers, never the agent's readiness — by contract
                     # this section can never turn /healthz red.
                     body["hotspots"] = hotspots
+                if sinks is not None:
+                    # Secondary sinks are fail-open by contract: their
+                    # error counters are surfaced here for operators,
+                    # and can never turn readiness red — the pprof ship
+                    # (the readiness-relevant path) rides the profiler
+                    # actor's own health.
+                    body["sinks"] = sinks
                 self._send(503 if status == "dead" else 200,
                            json.dumps(body, indent=1).encode(),
                            "application/json")
@@ -651,6 +693,7 @@ class AgentHTTPServer:
         self.statics_store = statics_store
         self.recorder = recorder
         self.hotspots = hotspots
+        self.sinks = sinks
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info
