@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks trace-smoke hotspot-smoke fixtures golden clean install
+.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale trace-smoke hotspot-smoke fixtures golden clean install
 
 all: native
 
@@ -38,7 +38,7 @@ test-live:
 # coverage honest (every SITES entry exercised here, and vice versa),
 # so drift fails fast before any test runs.
 chaos: lint
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the
@@ -85,6 +85,16 @@ bench-hotspot:
 # acceptance check. Host-bound, so it pins the cpu backend.
 bench-sinks:
 	JAX_PLATFORMS=cpu PARCA_BENCH_SINK_CHILD=1 $(PYTHON) bench.py
+
+# Multi-tenant pid-axis sweep (docs/robustness.md "multi-tenant
+# admission"): 50k -> 200k -> 500k pids through one dict aggregator
+# with 32 tenants and ONE tenant 10x over quota at the top tier —
+# close latency + registry RSS per tier, zero windows lost, zero
+# in-quota tenants degraded, mid-tier close within 2x of the low tier.
+# Host-bound, so it pins the cpu backend. PARCA_BENCH_SCALE_TIERS
+# overrides the tier list for quick runs.
+bench-scale:
+	JAX_PLATFORMS=cpu PARCA_BENCH_SCALE_CHILD=1 $(PYTHON) bench.py
 
 # Hotspot end-to-end smoke (docs/hotspots.md): a short real profiler
 # session (dict aggregator, encode pipeline) must serve human-readable

@@ -1974,6 +1974,149 @@ def _sink_fanout() -> dict:
     return phase
 
 
+def _scale_sweep() -> dict:
+    """`make bench-scale`: 10x the pid axis under multi-tenant admission
+    (docs/robustness.md "multi-tenant admission"). One dict aggregator
+    rides three pid tiers (50k -> 200k -> 500k by default) with 32
+    tenants; at the TOP tier one tenant drives ~10x its sample quota.
+    Tracked per tier: window-close latency (first + steady median),
+    registry rows, process RSS, and admission accounting cost. Bars
+    (the error field, scored via _finalize_result): zero windows lost,
+    zero non-offending tenants degraded, the noisy tenant DOES degrade
+    at the top tier, and the 200k-tier steady close stays within 2x of
+    the 50k tier's."""
+    import resource  # noqa: F401 - linux-only bench path
+
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+    from parca_agent_tpu.capture.formats import STACK_SLOTS, MappingTable, \
+        WindowSnapshot
+    from parca_agent_tpu.runtime.admission import AdmissionController
+    from parca_agent_tpu.runtime.quarantine import LEVEL_FULL
+
+    tiers = [int(x) for x in os.environ.get(
+        "PARCA_BENCH_SCALE_TIERS", "50000,200000,500000").split(",")]
+    windows = max(2, int(os.environ.get("PARCA_BENCH_SCALE_WINDOWS", 3)))
+    n_tenants = 32
+    noisy = "svc:t0"
+
+    class _SynthResolver:
+        """Deterministic pid -> tenant spread (32 tenants round-robin);
+        the real cgroup resolver is exercised by tests/test_admission.py
+        — this drill measures the CONTROLLER at scale."""
+
+        stats: dict = {}
+
+        def resolve(self, pid: int) -> str:
+            return f"svc:t{int(pid) % n_tenants}"
+
+    def _rss_mb() -> float:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE") \
+                / (1 << 20)
+
+    def _tier_snapshot(pids_n: int, noisy_mult: int) -> WindowSnapshot:
+        n = pids_n * 2  # two unique stacks per pid
+        pids = np.repeat(np.arange(1, pids_n + 1, dtype=np.int64), 2)
+        stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+        row = np.arange(n, dtype=np.uint64)
+        stacks[:, 0] = 0x10000 + row * 0x40
+        stacks[:, 1] = 0x900000 + (row % 4096) * 0x10
+        counts = np.ones(n, np.int64)
+        if noisy_mult > 1:
+            counts[pids % n_tenants == 0] = noisy_mult
+        return WindowSnapshot(
+            pids=pids, tids=pids, counts=counts,
+            user_len=np.full(n, 2, np.int32),
+            kernel_len=np.zeros(n, np.int32),
+            stacks=stacks, mappings=MappingTable.empty(),
+        )
+
+    top = max(tiers)
+    # Fair share at the LARGEST tier with 2x headroom: the noisy
+    # tenant's 10x burst lands ~5x over it; every other tenant stays at
+    # half quota even at 500k pids.
+    quota = int(2 * top * 2 / n_tenants)
+    adm = AdmissionController(
+        _SynthResolver(), quota_samples=quota, burst_windows=1,
+        degrade_after=1, escalate_after=2, recover_windows=2)
+    cap = 1 << max(16, (4 * top - 1).bit_length())
+    agg = DictAggregator(capacity=cap, id_cap=1 << (2 * top - 1)
+                         .bit_length(), overflow="sketch")
+
+    phase: dict = {"tiers": [], "windows_per_tier": windows,
+                   "tenants": n_tenants, "quota_samples": quota}
+    windows_lost = 0
+    innocent_degraded = 0
+    for pids_n in tiers:
+        noisy_mult = 10 if pids_n == top else 1
+        snap = _tier_snapshot(pids_n, noisy_mult)
+        want_mass = int(snap.counts.sum())
+        closes = []
+        feeds = []
+        account_s = []
+        for w in range(windows):
+            t0 = time.perf_counter()
+            adm.account_window(snap.pids, snap.counts)
+            account_s.append(time.perf_counter() - t0)
+            # Feed and close timed APART: feed work is O(rows) and in
+            # production overlaps capture (docs/perf.md "sub-RTT close"
+            # — the capture thread pays dispatch only); the CLOSE is
+            # the capture-stall metric the 2x bar judges. First-window
+            # closes per tier carry the registry insertion (that
+            # tier's new-key settle); steady closes are the production
+            # number.
+            agg.discard_open_window()
+            t0 = time.perf_counter()
+            agg.feed(snap)
+            feeds.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            counts = agg.close_window(copy=True)
+            closes.append(time.perf_counter() - t0)
+            if int(np.asarray(counts).sum()) != want_mass:
+                windows_lost += 1
+            adm.tick_window(close_latency_s=closes[-1],
+                            registry_rows=int(agg._next_id))
+        for t in range(1, n_tenants):  # every in-quota tenant untouched
+            if adm.tenant_level(f"svc:t{t}") != LEVEL_FULL:
+                innocent_degraded += 1
+        tier = {
+            "pids": pids_n,
+            "rows": pids_n * 2,
+            "noisy_mult": noisy_mult,
+            "feed_ms": round(_median_ms(feeds), 2),
+            "close_first_ms": round(closes[0] * 1e3, 2),
+            "close_steady_ms": round(_median_ms(closes[1:]), 2),
+            "admission_account_ms": round(_median_ms(account_s), 2),
+            "registry_rows": int(agg._next_id),
+            "rss_mb": round(_rss_mb(), 1),
+            "noisy_level": adm.tenant_level(noisy),
+        }
+        phase["tiers"].append(tier)
+        _progress(f"scale tier {pids_n} pids: steady close "
+                  f"{tier['close_steady_ms']}ms, rss {tier['rss_mb']}MB")
+    phase["windows_lost"] = windows_lost
+    phase["innocent_tenants_degraded"] = innocent_degraded
+    phase["admission"] = {k: v for k, v in adm.stats.items()
+                          if isinstance(v, int)}
+    by_pids = {t["pids"]: t for t in phase["tiers"]}
+    lo, mid = min(tiers), sorted(tiers)[len(tiers) // 2]
+    ratio = (by_pids[mid]["close_steady_ms"]
+             / max(by_pids[lo]["close_steady_ms"], 1e-9))
+    phase["close_ratio_mid_vs_low"] = round(ratio, 2)
+    if windows_lost:
+        phase["error"] = f"{windows_lost} windows lost mass at scale"
+    elif innocent_degraded:
+        phase["error"] = (f"{innocent_degraded} in-quota tenants were "
+                          "degraded")
+    elif by_pids[top]["noisy_level"] == LEVEL_FULL:
+        phase["error"] = ("the 10x-over-quota tenant was never degraded "
+                          "(admission asleep)")
+    elif ratio > 2.0:
+        phase["error"] = (f"steady close at {mid} pids is {ratio:.2f}x "
+                          f"the {lo}-pid tier (bar: 2x)")
+    return phase
+
+
 def _finalize_result(result: dict, device_alive: bool,
                      probe_log: list | None = None,
                      attempt_hung: bool = False,
@@ -2113,6 +2256,22 @@ def _sink_main() -> None:
     print(json.dumps({"metric": "sink_fanout", **phase}))
 
 
+def _scale_main() -> None:
+    """`make bench-scale`: the multi-tenant pid-axis sweep alone, one
+    JSON line. Host-bound (dict feed/close on the pinned backend; the
+    admission controller is pure host work)."""
+    try:
+        phase = _scale_sweep()
+    except Exception as e:  # noqa: BLE001 - the line must still print
+        phase = {"error": repr(e)[:300]}
+    import jax
+
+    phase["backend"] = jax.default_backend()
+    _finalize_result(phase, device_alive=True,
+                     require_full_scale=False, require_device=False)
+    print(json.dumps({"metric": "scale_sweep", **phase}))
+
+
 def _hotspot_main() -> None:
     """`make bench-hotspot`: the hotspot rollup drill alone, one JSON
     line. Numpy-only — the backend stamp just records the pin."""
@@ -2157,6 +2316,9 @@ def main() -> None:
         return
     if os.environ.get("PARCA_BENCH_SINK_CHILD"):
         _sink_main()
+        return
+    if os.environ.get("PARCA_BENCH_SCALE_CHILD"):
+        _scale_main()
         return
     if os.environ.get("PARCA_BENCH_PROBE_CHILD"):
         _probe_main()

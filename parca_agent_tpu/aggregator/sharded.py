@@ -43,6 +43,32 @@ from parca_agent_tpu.aggregator.dict import (
 from parca_agent_tpu.parallel.mesh import FLEET_AXIS, fleet_mesh
 
 
+def route_h2(h2: np.ndarray, pids, shard_of_pid, n_shards: int
+             ) -> np.ndarray:
+    """Rewrite each row's h2 so ``h2 % n_shards == shard_of_pid(pid)``
+    while keeping the rest of the hash: the home-shard rule (everywhere
+    ``h2 % n_shards`` is consulted — the host mirror's ``_home_shard``
+    and the feed partition) then routes by TENANT instead of by raw
+    hash, so one tenant's registry growth lands on its home sub-table
+    and parallelizes across chips per tenant (docs/robustness.md
+    "multi-tenant admission"). Key identity stays per-(stack, pid):
+    every row of a pid carries the same replacement residue, so equal
+    stacks still collide into one key and different pids already
+    differed in h1/h3. Exact for any n_shards: computed in int64 with
+    the top partial block stepped down one stride instead of wrapping
+    (a uint32 wrap would break the residue for non-power-of-two shard
+    counts)."""
+    n = int(n_shards)
+    upids, inverse = np.unique(np.asarray(pids, np.int64),
+                               return_inverse=True)
+    residues = np.array([int(shard_of_pid(int(p))) % n for p in upids],
+                        np.int64)
+    out = (np.asarray(h2, np.uint32).astype(np.int64) // n) * n \
+        + residues[inverse]
+    out = np.where(out > 0xFFFFFFFF, out - n, out)
+    return out.astype(np.uint32)
+
+
 @functools.lru_cache(maxsize=8)
 def _sharded_feed_program(mesh, n_shards: int, cap_s: int, id_cap: int,
                           n_pad_s: int):
@@ -140,7 +166,8 @@ class ShardedDictAggregator(DictAggregator):
     name = "sharded-dict"
 
     def __init__(self, capacity: int = 1 << 21, id_cap: int | None = None,
-                 mesh=None, n_shards: int | None = None, **kw):
+                 mesh=None, n_shards: int | None = None,
+                 shard_of_pid=None, **kw):
         if mesh is None:
             import jax
 
@@ -153,6 +180,14 @@ class ShardedDictAggregator(DictAggregator):
         if cap_s & (cap_s - 1):
             raise ValueError("per-shard capacity must be a power of two")
         self._cap_s = cap_s
+        # Optional pid -> home-shard router (the admission layer's
+        # tenant placement, runtime/admission.py shard_of): with it set,
+        # hash_rows rewrites h2's shard residue per pid (route_h2) so
+        # both the host mirror's _home_shard and the feed partition
+        # place by tenant. Must be stable per pid across windows — a
+        # re-route would mint a second key for the same stack (harmless
+        # mass-wise, wasteful registry-wise; rotation reclaims it).
+        self._shard_of_pid = shard_of_pid
         self._part_bufs: dict[int, np.ndarray] = {}  # n_pad_s -> buffer
         super().__init__(capacity=capacity, id_cap=id_cap, **kw)
         # Delta-fetch touch tracking is single-chip for now: the sharded
@@ -163,6 +198,20 @@ class ShardedDictAggregator(DictAggregator):
         self._n_blocks = 0
         self._touch = None
         self._touch_spare = None
+
+    def set_shard_router(self, shard_of_pid) -> None:
+        """Install the pid router (tenant placement) at wiring time —
+        BEFORE the first feed: keys already inserted under the raw-hash
+        rule keep their placement (rotation reclaims them), so a mid-run
+        install only fragments the registry, it never corrupts it."""
+        self._shard_of_pid = shard_of_pid
+
+    def hash_rows(self, snapshot):
+        h1, h2, h3 = super().hash_rows(snapshot)
+        if self._shard_of_pid is not None:
+            h2 = route_h2(h2, snapshot.pids, self._shard_of_pid,
+                          self._n_shards)
+        return h1, h2, h3
 
     # -- host-mirror placement: probe within the key's home sub-table -------
 

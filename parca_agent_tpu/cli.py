@@ -110,6 +110,50 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-pid ingest processing deadline in seconds; "
                         "a pid whose maps/ELF processing exceeds it is "
                         "charged an input fault (0 = no deadline)")
+    p.add_argument("--tenant-quota-samples", type=int, default=0,
+                   help="multi-tenant admission (docs/robustness.md "
+                        "\"multi-tenant admission\"): per-tenant sample "
+                        "budget per window (token bucket banking "
+                        "--tenant-burst-windows of burst); a tenant "
+                        "sustaining usage past it rides the degradation "
+                        "ladder (full -> addresses-only -> scalar) "
+                        "without dropping samples and without touching "
+                        "in-quota tenants. Tenants are resolved from "
+                        "/proc/<pid>/cgroup. 0 (with "
+                        "--tenant-quota-pids 0) disables admission")
+    p.add_argument("--tenant-quota-pids", type=int, default=0,
+                   help="per-tenant distinct-pid budget per window "
+                        "(same token-bucket/ladder semantics; the churn "
+                        "axis of the quota). 0 disables the pid quota")
+    p.add_argument("--tenant-burst-windows", type=int, default=3,
+                   help="windows of quota a quiet tenant may bank (the "
+                        "token buckets' burst cap)")
+    p.add_argument("--tenant-top-n", type=int, default=10,
+                   help="tenants exported individually on /metrics "
+                        "(top-N by window mass + every degraded tenant "
+                        "+ one 'other' rollup — bounded cardinality)")
+    p.add_argument("--overload-close-latency", type=float, default=0.0,
+                   help="overload governor: window close latency "
+                        "(seconds) past which the agent counts as over "
+                        "budget; sustained overload sheds fidelity from "
+                        "the heaviest tenants first (0 disables this "
+                        "signal)")
+    p.add_argument("--overload-registry-rows", type=int, default=0,
+                   help="overload governor: dict-registry unique-stack "
+                        "rows past which the agent counts as over "
+                        "budget (0 disables this signal)")
+    p.add_argument("--overload-backlog", type=int, default=0,
+                   help="overload governor: encode-pipeline "
+                        "backpressure fallbacks per window past which "
+                        "the agent counts as over budget (0 disables "
+                        "this signal)")
+    p.add_argument("--overload-shed-after", type=int, default=3,
+                   help="consecutive over-budget windows before the "
+                        "governor sheds one ladder step from the "
+                        "heaviest tenants")
+    p.add_argument("--overload-recover-after", type=int, default=6,
+                   help="consecutive in-budget windows before the "
+                        "governor releases one shed step")
     p.add_argument("--remote-store-insecure-skip-verify",
                    action="store_true",
                    help="skip TLS certificate verification: the server's "
@@ -575,6 +619,74 @@ def run(argv=None) -> int:
             promote_after=args.device_promote_after)
         device_health.start()
 
+    # -- multi-tenant admission (docs/robustness.md) -------------------------
+    # Per-tenant (cgroup-derived) window quotas riding the quarantine
+    # ladder, the global overload governor, and tenant-keyed pid->shard
+    # routing for the sharded aggregator. Constructed before labels so
+    # the TenantProvider can stamp the same identity onto every profile
+    # (the /query + /hotspots `tenant=` selector slices by it).
+    admission = None
+    tenant_resolver = None
+    if args.tenant_quota_samples > 0 or args.tenant_quota_pids > 0:
+        from parca_agent_tpu.runtime.admission import (
+            AdmissionController,
+            OverloadPolicy,
+            TenantResolver,
+        )
+
+        for flag, v in (("--tenant-quota-samples",
+                         args.tenant_quota_samples),
+                        ("--tenant-quota-pids", args.tenant_quota_pids),
+                        ("--overload-registry-rows",
+                         args.overload_registry_rows),
+                        ("--overload-backlog", args.overload_backlog)):
+            if v < 0:
+                raise SystemExit(f"{flag} must be >= 0")
+        for flag, v in (("--tenant-burst-windows",
+                         args.tenant_burst_windows),
+                        ("--tenant-top-n", args.tenant_top_n),
+                        ("--overload-shed-after",
+                         args.overload_shed_after),
+                        ("--overload-recover-after",
+                         args.overload_recover_after)):
+            if v < 1:
+                raise SystemExit(f"{flag} must be >= 1")
+        if args.overload_close_latency < 0:
+            raise SystemExit("--overload-close-latency must be >= 0")
+        tenant_resolver = TenantResolver()
+        admission = AdmissionController(
+            tenant_resolver,
+            quota_samples=args.tenant_quota_samples,
+            quota_pids=args.tenant_quota_pids,
+            burst_windows=args.tenant_burst_windows,
+            overload=OverloadPolicy(
+                close_latency_s=args.overload_close_latency,
+                registry_rows=args.overload_registry_rows,
+                backlog=args.overload_backlog,
+                shed_after=args.overload_shed_after,
+                recover_after=args.overload_recover_after),
+            top_n=args.tenant_top_n)
+        if hasattr(aggregator, "set_shard_router"):
+            # Tenant-keyed home shards: one tenant's registry growth
+            # parallelizes across chips by tenant instead of spraying
+            # every sub-table (aggregator/sharded.py route_h2).
+            aggregator.set_shard_router(
+                lambda pid: admission.shard_of(pid,
+                                               aggregator._n_shards))
+        log.info("multi-tenant admission active",
+                 quota_samples=args.tenant_quota_samples,
+                 quota_pids=args.tenant_quota_pids)
+        if args.fast_encode:
+            # Same enforcement shape as the quarantine ladder on this
+            # path: fast-encode output is addresses-only for every pid
+            # by design, so the ladder's level-1 rung is the baseline
+            # and the scalar collapse applies on the scalar/symbolized
+            # path only (runtime/admission.py module docs).
+            log.info("fast-encode ships addresses-only by design; "
+                     "admission enforces quotas via accounting/"
+                     "routing/governor there, scalar collapse on the "
+                     "scalar path")
+
     # -- transport -----------------------------------------------------------
     if args.remote_store_address:
         from parca_agent_tpu.agent.grpc_client import GRPCStoreClient
@@ -638,16 +750,23 @@ def run(argv=None) -> int:
     discovery.apply_config(providers)
 
     sd_provider = ServiceDiscoveryProvider()
+    label_providers = [
+        sd_provider,
+        ProcessProvider(),
+        CgroupProvider(),
+        SystemProvider(),
+        TargetProvider(node=args.node,
+                       external=_parse_external_labels(
+                           args.metadata_external_labels)),
+    ]
+    if tenant_resolver is not None:
+        from parca_agent_tpu.metadata.providers import TenantProvider
+
+        # The admission layer's tenant identity as a profile label, so
+        # the read path can slice by exactly what the quotas enforce.
+        label_providers.insert(3, TenantProvider(resolver=tenant_resolver))
     labels_mgr = LabelsManager(
-        [
-            sd_provider,
-            ProcessProvider(),
-            CgroupProvider(),
-            SystemProvider(),
-            TargetProvider(node=args.node,
-                           external=_parse_external_labels(
-                               args.metadata_external_labels)),
-        ],
+        label_providers,
         relabel_configs=(load_config_file(args.config_path).relabel_configs
                          if args.config_path else []),
         profiling_duration_s=args.profiling_duration,
@@ -718,6 +837,11 @@ def run(argv=None) -> int:
             max_strikes=args.quarantine_max_strikes,
             quarantine_windows=args.quarantine_windows,
             deadline_s=args.quarantine_pid_deadline or None)
+        if tenant_resolver is not None:
+            # Per-tenant eviction scoping at the tracked-pid cap: a
+            # pid-churn storm from one tenant recycles its own slots
+            # instead of flushing other tenants' quarantine history.
+            quarantine.tenant_of = tenant_resolver.resolve
         if hasattr(source, "quarantine"):
             source.quarantine = quarantine
     feeder = None
@@ -880,7 +1004,8 @@ def run(argv=None) -> int:
         fallback_aggregator=fallback,
         symbolizer=(None if args.fast_encode
                     else Symbolizer(ksym=KsymCache(), perf=PerfMapCache(),
-                                    quarantine=quarantine)),
+                                    quarantine=quarantine,
+                                    admission=admission)),
         labels_manager=labels_mgr,
         profile_writer=writer,
         debuginfo=debuginfo,
@@ -895,6 +1020,7 @@ def run(argv=None) -> int:
         encode_pipeline=args.fast_encode and not args.no_encode_pipeline,
         encode_deadline_s=args.encode_deadline or None,
         quarantine=quarantine,
+        admission=admission,
         device_health=device_health,
         statics_store=statics_store,
         statics_snapshot_every=args.statics_snapshot_interval,
@@ -937,6 +1063,8 @@ def run(argv=None) -> int:
                 ctx["quarantine"] = quarantine.snapshot()
             if statics_store is not None:
                 ctx["statics"] = statics_store.snapshot_info()
+            if admission is not None:
+                ctx["admission"] = admission.snapshot()
             return ctx
 
         recorder.set_context(_trace_context)
@@ -1027,7 +1155,8 @@ def run(argv=None) -> int:
                            statics_store=statics_store,
                            recorder=recorder,
                            hotspots=hotspot_store,
-                           sinks=sink_registry)
+                           sinks=sink_registry,
+                           admission=admission)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None

@@ -13,6 +13,7 @@ import dataclasses
 import re
 from typing import Protocol
 
+from parca_agent_tpu.utils.poison import PoisonInput
 from parca_agent_tpu.utils.vfs import VFS, RealFS
 
 
@@ -21,6 +22,47 @@ class Provider(Protocol):
     should_cache: bool
 
     def labels(self, pid: int) -> dict[str, str]: ...
+
+
+class CgroupParseError(PoisonInput):
+    """A `/proc/<pid>/cgroup` file past its sanity caps. The file is
+    kernel-generated, but its CONTENT is attacker-influenced (cgroup
+    paths are named by whoever creates the cgroup) and pid reuse means
+    the read can race an exit — same PoisonInput discipline as the
+    maps/perfmap parsers (docs/robustness.md "ingest containment")."""
+
+    site = "cgroup.parse"
+
+
+# A real cgroup file is a handful of lines (one per v1 hierarchy plus
+# the v2 line); hundreds means something is feeding us garbage. The
+# byte cap bounds the READ itself through poison.read_bounded at every
+# call site (CgroupProvider below, runtime/admission.py TenantResolver).
+CGROUP_MAX_BYTES = 1 << 20
+_CGROUP_MAX_ROWS = 256
+
+
+def parse_cgroup_path(data: bytes) -> str | None:
+    """Primary cgroup path out of a `/proc/<pid>/cgroup` blob — prefer
+    the v2 line ("0::/path"), else the cpu controller, else the first
+    well-formed line. Malformed lines are skipped (kernel files can
+    still truncate mid-write on pid exit); a file past the row cap
+    raises CgroupParseError (a PoisonInput, chargeable to the pid)."""
+    best = None
+    rows = 0
+    for line in data.decode(errors="replace").splitlines():
+        rows += 1
+        if rows > _CGROUP_MAX_ROWS:
+            raise CgroupParseError(
+                f"cgroup file exceeds row cap ({_CGROUP_MAX_ROWS})")
+        parts = line.split(":", 2)
+        if len(parts) != 3:
+            continue
+        if parts[0] == "0" and parts[1] == "":
+            return parts[2]
+        if best is None or "cpu" in parts[1].split(","):
+            best = parts[2]
+    return best
 
 
 @dataclasses.dataclass
@@ -60,23 +102,37 @@ class CgroupProvider:
     should_cache: bool = True
 
     def labels(self, pid: int) -> dict[str, str]:
+        from parca_agent_tpu.utils.poison import read_bounded
+
+        # Bounded like every other /proc reader (PR 4 taxonomy): the
+        # read itself is capped, the parse is row-capped, and poison
+        # costs this pid its cgroup label, never the label pass.
         try:
-            data = self.fs.read_bytes(f"/proc/{pid}/cgroup")
-        except OSError:
+            data = read_bounded(self.fs, f"/proc/{pid}/cgroup",
+                                CGROUP_MAX_BYTES, site="cgroup.parse")
+            best = parse_cgroup_path(data)
+        except (OSError, PoisonInput):
             return {}
-        # cgroup v2 line: "0::/path"; v1: "N:controller:/path" — prefer v2,
-        # else the cpu controller, else the first line.
-        best = None
-        for line in data.decode(errors="replace").splitlines():
-            parts = line.split(":", 2)
-            if len(parts) != 3:
-                continue
-            if parts[0] == "0" and parts[1] == "":
-                best = parts[2]
-                break
-            if best is None or "cpu" in parts[1].split(","):
-                best = parts[2]
         return {"cgroup_name": best} if best else {}
+
+
+@dataclasses.dataclass
+class TenantProvider:
+    """PID -> tenant identity label, fed by the admission layer's
+    TenantResolver (runtime/admission.py). The label key is the
+    admission layer's TENANT_LABEL ("tenant"), so the read path's
+    `tenant=` selector shorthand (/query, /hotspots) slices by exactly
+    the identity the quotas enforce."""
+
+    resolver: object = None
+    name: str = "tenant"
+    should_cache: bool = True
+
+    def labels(self, pid: int) -> dict[str, str]:
+        if self.resolver is None:
+            return {}
+        tenant = self.resolver.resolve(pid)
+        return {"tenant": tenant} if tenant else {}
 
 
 @dataclasses.dataclass

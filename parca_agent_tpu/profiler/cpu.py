@@ -85,6 +85,7 @@ class CPUProfiler:
         encode_pipeline: bool = False,
         encode_deadline_s: float | None = None,
         quarantine=None,
+        admission=None,
         device_health=None,
         statics_store=None,
         statics_snapshot_every: int = 6,
@@ -109,6 +110,15 @@ class CPUProfiler:
         # with the capture source, the feeder, the symbolizer, and the
         # unwind builder — one budget per pid across every ingest site.
         self._quarantine = quarantine
+        # Multi-tenant admission (runtime/admission.py): the profiler
+        # owns the window clock here too — each window's snapshot usage
+        # is charged to its tenants at the top of the iteration, the
+        # controller ticks beside the quarantine registry, and the
+        # governor reads this loop's own overload signals (close
+        # latency, registry rows, encode backpressure). Both entry
+        # points are fail-open by the controller's own contract, so the
+        # calls ride unguarded.
+        self._admission = admission
         # Fast write path: aggregate counts + vectorized template encoder,
         # no per-pid PidProfile objects or scalar pprof serialization on
         # the hot loop. Profiles ship unsymbolized (the reference agent's
@@ -475,6 +485,12 @@ class CPUProfiler:
             return False
         self.last_profile_started_at = time.time()
         self.metrics.attempts_total += 1
+        if self._admission is not None:
+            # Per-tenant usage accounting BEFORE the close: the ladder
+            # levels this window's profiles ride were set by last tick
+            # (admission reacts on the window clock, one window behind —
+            # the same cadence as quarantine cooldowns).
+            self._admission.account_window(snapshot.pids, snapshot.counts)
         tr.annotate(time_ns=snapshot.time_ns,
                     samples=int(snapshot.total_samples()))
         t_start = time.perf_counter()
@@ -494,7 +510,8 @@ class CPUProfiler:
                 # symbols, level-2 pids collapse to scalar counts), then
                 # symbolize — which itself skips laddered pids, so a
                 # degraded profile can never be re-symbolized.
-                profiles = apply_ladder(profiles, self._quarantine)
+                profiles = apply_ladder(profiles, self._quarantine,
+                                        self._admission)
 
                 if self._symbolizer is not None:
                     with tr.span("symbolize") as sp_sym:
@@ -539,6 +556,17 @@ class CPUProfiler:
             # Quarantine time is window time: cooldown/probation advance
             # once per iteration, whether or not the window shipped.
             self._quarantine.tick_window()
+        if self._admission is not None:
+            # Admission rides the same clock: buckets refill, ladder
+            # levels adjust, and the overload governor judges THIS
+            # window's close latency / registry growth / encode
+            # backpressure (tick_window is fail-open by contract).
+            self._admission.tick_window(
+                close_latency_s=self.metrics.last_aggregate_duration_s,
+                registry_rows=int(
+                    getattr(self._aggregator, "_next_id", 0) or 0),
+                backlog=(self._pipeline.stats["backpressure_fallbacks"]
+                         if self._pipeline is not None else 0))
         if self._health is not None:
             # Same clock for the device-backend state machine: demote
             # cooldowns and re-probe scheduling advance per window.

@@ -64,6 +64,7 @@ class _PidState:
     errored_this_window: bool = False
     last_error: str = ""
     last_site: str = ""
+    tenant: str = ""            # resolved once at insert (tenant_of set)
 
 
 class QuarantineRegistry:
@@ -93,6 +94,12 @@ class QuarantineRegistry:
         self._healthy_after = max(1, healthy_after_windows)
         self.deadline_s = deadline_s
         self._clock = clock
+        # Optional pid -> tenant hook (runtime/admission.py's resolver):
+        # with it set, tracked-pid-cap eviction is scoped PER TENANT, so
+        # a pid-churn storm from one tenant can only flush that tenant's
+        # own quarantine history, never another's. Set once at wiring
+        # time (cli.py), before any recording.
+        self.tenant_of = None
         self._lock = threading.Lock()
         self._pids: dict[int, _PidState] = {}  # guarded-by: _lock
         self.stats = {  # guarded-by: _lock
@@ -114,16 +121,17 @@ class QuarantineRegistry:
     def record_error(self, pid: int, site: str, exc: BaseException) -> int:
         """One attributable input fault for ``pid``; returns the pid's
         ladder level after recording."""
+        tenant = self._tenant_for(pid)
         with self._lock:
             if int(pid) not in self._pids \
                     and len(self._pids) >= self._MAX_TRACKED \
-                    and not self._evict_one_locked():
+                    and not self._evict_one_locked(tenant):
                 # Every tracked entry is quarantined: refuse the insert
                 # rather than exceed the bound (or flush containment
                 # state); the fault is still counted.
                 self.stats["errors_total"] += 1
                 return LEVEL_FULL
-            st = self._pids.setdefault(int(pid), _PidState())
+            st = self._pids.setdefault(int(pid), _PidState(tenant=tenant))
             self.stats["errors_total"] += 1
             st.errored_this_window = True
             st.ok_windows = 0
@@ -154,26 +162,47 @@ class QuarantineRegistry:
             self.stats["deadline_trips_total"] += 1
         return level
 
-    def _evict_one_locked(self) -> bool:  # palint: holds=_lock
+    def _tenant_for(self, pid: int) -> str:
+        """Tenant of a pid about to be tracked; "" without a resolver or
+        on a resolver failure (eviction then falls back to the global
+        rule — the resolver is itself fail-open, this is belt-and-
+        braces). Called OUTSIDE the registry lock: the resolver takes
+        its own lock and may touch /proc."""
+        if self.tenant_of is None:
+            return ""
+        try:
+            return str(self.tenant_of(int(pid)))
+        except Exception:  # noqa: BLE001 - eviction scoping is best-effort
+            return ""
+
+    def _evict_one_locked(self, tenant: str = "") -> bool:  # palint: holds=_lock
         """Make room at the tracked-pid cap: evict the least-incriminated
         non-quarantined entry (fewest trips, then strikes, oldest first),
         so a churn of one-error pids can never flush a persistently
-        poisonous pid's accumulated state. False when every entry is
-        quarantined (nothing evictable)."""
-        victim = None
-        victim_key = None
-        for old, st in self._pids.items():
-            if st.state == "quarantined":
-                continue
-            key = (st.trips, st.strikes)
-            if victim is None or key < victim_key:
-                victim, victim_key = old, key
-                if key == (0, 0):
-                    break  # nothing beats a clean watched entry
-        if victim is None:
-            return False
-        del self._pids[victim]
-        return True
+        poisonous pid's accumulated state. With a tenant resolved for the
+        INCOMING pid, the victim is drawn from that pid's OWN tenant
+        first — a pid-churn storm from one tenant then recycles its own
+        slots and other tenants' quarantine history survives; only a
+        tenant with nothing evictable falls back to the global scan.
+        False when every candidate entry is quarantined (nothing
+        evictable)."""
+        scopes = ([lambda st: st.tenant == tenant, lambda st: True]
+                  if tenant else [lambda st: True])
+        for in_scope in scopes:
+            victim = None
+            victim_key = None
+            for old, st in self._pids.items():
+                if st.state == "quarantined" or not in_scope(st):
+                    continue
+                key = (st.trips, st.strikes)
+                if victim is None or key < victim_key:
+                    victim, victim_key = old, key
+                    if key == (0, 0):
+                        break  # nothing beats a clean watched entry
+            if victim is not None:
+                del self._pids[victim]
+                return True
+        return False
 
     def check_deadline(self, pid: int, t0: float) -> None:
         """Caller-timed deadline check: ``t0`` from ``registry.clock()``."""
@@ -346,22 +375,35 @@ def scalar_profile(prof):
     )
 
 
-def apply_ladder(profiles, registry: QuarantineRegistry | None):
-    """Route each profile down its pid's ladder level. Level 0 passes
-    through untouched; level 1 strips local symbolization artifacts
-    (normalized addresses + build ids still travel — byte-identical to
-    an unsymbolized profile through the pprof builder); level 2 becomes
-    the scalar count. Never drops a profile."""
-    if registry is None:
+def apply_ladder(profiles, registry: QuarantineRegistry | None,
+                 admission=None):
+    """Route each profile down its pid's ladder level — the max of the
+    quarantine registry's (poison containment) and the admission
+    controller's (quota/overload fairness, runtime/admission.py) when
+    both are wired. Level 0 passes through untouched; level 1 strips
+    local symbolization artifacts (normalized addresses + build ids
+    still travel — byte-identical to an unsymbolized profile through
+    the pprof builder); level 2 becomes the scalar count. Never drops a
+    profile, and degraded mass is charged to whichever layer demanded
+    the deeper level."""
+    if registry is None and admission is None:
         return list(profiles)
     out = []
     degraded_samples = 0
+    admission_samples = 0
     for prof in profiles:
-        lvl = registry.level(prof.pid)
+        q_lvl = registry.level(prof.pid) if registry is not None \
+            else LEVEL_FULL
+        a_lvl = admission.level_for(prof.pid) if admission is not None \
+            else LEVEL_FULL
+        lvl = max(q_lvl, a_lvl)
         if lvl == LEVEL_FULL:
             out.append(prof)
             continue
-        degraded_samples += prof.total()
+        if a_lvl > q_lvl:
+            admission_samples += prof.total()
+        else:
+            degraded_samples += prof.total()
         if lvl == LEVEL_ADDRESSES:
             prof.functions = []
             prof.loc_lines = None
@@ -371,4 +413,6 @@ def apply_ladder(profiles, registry: QuarantineRegistry | None):
     if degraded_samples:
         with registry._lock:
             registry.stats["samples_degraded_total"] += degraded_samples
+    if admission_samples:
+        admission.count_degraded(admission_samples)
     return out

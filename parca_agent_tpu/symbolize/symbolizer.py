@@ -25,22 +25,29 @@ from parca_agent_tpu.utils.poison import PoisonInput
 class Symbolizer:
     def __init__(self, ksym: KsymCache | None = None,
                  perf: PerfMapCache | None = None,
-                 quarantine=None):
+                 quarantine=None, admission=None):
         self._ksym = ksym
         self._perf = perf
         self._quarantine = quarantine
+        self._admission = admission
         self.last_errors: dict[int, Exception] = {}
         self._fn_ids: dict[int, dict[str, int]] = {}
 
     def symbolize(self, profiles: Iterable[PidProfile]) -> None:
         """Fill functions/loc_lines in place for each profile. Pids on
-        the degradation ladder (runtime/quarantine.py) are skipped: their
-        profiles ship addresses-only, exactly the reference's
-        server-side-symbolization contract (symbol.go:55-139)."""
+        the degradation ladder (runtime/quarantine.py) — whether placed
+        there by poison containment or by the admission layer's quotas
+        (runtime/admission.py) — are skipped: their profiles ship
+        addresses-only, exactly the reference's server-side-
+        symbolization contract (symbol.go:55-139), and apply_ladder's
+        stripping is never undone by a later symbolize pass."""
         profiles = list(profiles)
         if self._quarantine is not None:
             profiles = [p for p in profiles
                         if self._quarantine.level(p.pid) == 0]
+        if self._admission is not None:
+            profiles = [p for p in profiles
+                        if self._admission.level_for(p.pid) == 0]
         self._fn_ids = {}
         self.last_errors = {}
         self._resolve_kernel(profiles)

@@ -37,6 +37,16 @@ layer the ship-path components consult at NAMED SITES:
                       (sinks/autofdo.py; disk_full/error — counted
                       flush_errors, the file stays dirty and is
                       retried at the next flush cadence)
+    admission.resolve one pid's cgroup -> tenant resolution
+                      (runtime/admission.py) — fail-open by contract:
+                      an injected fault is counted (resolve_errors)
+                      and lands the pid in the "unknown" tenant,
+                      never costing a window
+    admission.shed    one overload-governor shed step
+                      (runtime/admission.py) — fail-open: an injected
+                      fault is counted (shed_errors) and costs this
+                      window's shed step only; quotas and windows are
+                      untouched
 
 and, on the ingest side (docs/robustness.md "ingest containment" — the
 ``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an
@@ -123,6 +133,8 @@ SITES = {
     "hotspot.fold": "hotspot rollup fold (runtime/hotspots.py)",
     "sink.emit": "secondary output-backend emit (sinks/registry.py)",
     "sink.flush": "AutoFDO profdata crash-only rewrite (sinks/autofdo.py)",
+    "admission.resolve": "pid -> tenant resolution (runtime/admission.py)",
+    "admission.shed": "overload-governor shed step (runtime/admission.py)",
     "elf.read": "ElfFile construction (elf/reader.py)",
     "perfmap.parse": "JIT perf-map read+parse (symbolize/perfmap.py)",
     "maps.parse": "/proc/<pid>/maps parse (process/maps.py)",

@@ -112,6 +112,13 @@ _MAPS = b"".join(
     for i in range(32)
 ) + b"7ffc0000-7ffd0000 rw-p 00000000 00:00 0 [stack]\n"
 
+_CGROUP = b"".join(
+    b"%d:%s:/kubepods/burstable/pod12345678-dead-beef-0000-%012d/%016x\n"
+    % (12 - i, ctrl, i, 0xABC0 + i)
+    for i, ctrl in enumerate((b"cpu,cpuacct", b"memory", b"pids",
+                              b"blkio", b"devices", b"freezer"))
+) + b"0::/system.slice/app-workload.service\n"
+
 _KALLSYMS = b"".join(
     b"%016x %c func_%d\n" % (0xffffffff81000000 + i * 0x40,
                              b"tT"[i % 2], i)
@@ -156,6 +163,13 @@ def _drive_kallsyms(data: bytes) -> None:
     parse_kallsyms(data)
 
 
+def _drive_cgroup(data: bytes) -> None:
+    from parca_agent_tpu.metadata.providers import parse_cgroup_path
+    from parca_agent_tpu.runtime.admission import tenant_from_cgroup
+
+    tenant_from_cgroup(parse_cgroup_path(data))
+
+
 # parser name -> (corpus thunk, driver). Thunks, not bytes: the ELF
 # corpus needs the writer, and import-time work here would tax every
 # agent start for a test-only path.
@@ -165,6 +179,7 @@ PARSERS: dict = {
     "perfmap": (lambda: _PERF_MAP, _drive_perfmap),
     "maps": (lambda: _MAPS, _drive_maps),
     "kallsyms": (lambda: _KALLSYMS, _drive_kallsyms),
+    "cgroup": (lambda: _CGROUP, _drive_cgroup),
 }
 
 

@@ -133,7 +133,8 @@ class _MetricsBuffer:
 def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    supervisor=None, quarantine=None,
                    device_health=None, statics_store=None,
-                   recorder=None, hotspots=None, sinks=None) -> str:
+                   recorder=None, hotspots=None, sinks=None,
+                   admission=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics and
     the window flight recorder's stage histograms
@@ -346,6 +347,33 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
         emit("parca_agent_hotspot_fleet_stale", int(m["stale"]))
         if "fleet_age_s" in m:
             emit("parca_agent_hotspot_fleet_age_seconds", m["fleet_age_s"])
+    if admission is not None:
+        # Multi-tenant admission (docs/robustness.md "multi-tenant
+        # admission"): per-tenant usage/ladder gauges at BOUNDED
+        # cardinality — the controller hands back the top-N tenants by
+        # last-window mass plus every currently-degraded tenant and one
+        # "other" rollup, so a pod-churn host can never blow up the
+        # scrape — and the admission/resolver counters.
+        m = admission.metrics()
+        for t in m["tenants"]:
+            lab = {"tenant": t["tenant"]}
+            if t["tenant"] != "other":
+                # The rollup's membership is recomputed per scrape, so
+                # a cumulative "other" series would DROP whenever a
+                # tenant migrates into the top-N — a fake counter
+                # reset. Only named tenants get the monotonic family;
+                # the rollup keeps the last-window gauges below.
+                emit("parca_agent_tenant_samples_total", t["samples"],
+                     lab)
+            emit("parca_agent_tenant_window_samples",
+                 t["window_samples"], lab)
+            emit("parca_agent_tenant_window_pids", t["pids"], lab)
+            emit("parca_agent_tenant_ladder_level", t["level"], lab)
+            emit("parca_agent_tenant_over_quota", t["over_quota"], lab)
+        for k, v in m["stats"].items():
+            emit(f"parca_agent_admission_{k}", v)
+        for k, v in m["resolver"].items():
+            emit(f"parca_agent_tenant_{k}", v)
     if sinks is not None:
         # Output-backend sinks (docs/sinks.md): the contract trio —
         # windows/bytes/errors per sink — as labeled families, every
@@ -392,7 +420,7 @@ class AgentHTTPServer:
                  version: str = "dev", extra_metrics=None,
                  capture_info=None, supervisor=None, quarantine=None,
                  device_health=None, statics_store=None, recorder=None,
-                 hotspots=None, sinks=None):
+                 hotspots=None, sinks=None, admission=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -423,7 +451,8 @@ class AgentHTTPServer:
                         statics_store=outer.statics_store,
                         recorder=outer.recorder,
                         hotspots=outer.hotspots,
-                        sinks=outer.sinks).encode())
+                        sinks=outer.sinks,
+                        admission=outer.admission).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
@@ -539,6 +568,8 @@ class AgentHTTPServer:
                             if outer.hotspots is not None else None)
                 sinks = (outer.sinks.snapshot()
                          if outer.sinks is not None else None)
+                admission = (outer.admission.snapshot()
+                             if outer.admission is not None else None)
                 if outer.supervisor is None:
                     body = {"status": "healthy", "actors": {}}
                     if quarantine is not None:
@@ -551,6 +582,8 @@ class AgentHTTPServer:
                         body["hotspots"] = hotspots
                     if sinks is not None:
                         body["sinks"] = sinks
+                    if admission is not None:
+                        body["admission"] = admission
                     self._send(200, json.dumps(body).encode(),
                                "application/json")
                     return
@@ -588,6 +621,12 @@ class AgentHTTPServer:
                     # (the readiness-relevant path) rides the profiler
                     # actor's own health.
                     body["sinks"] = sinks
+                if admission is not None:
+                    # Admission shedding is the agent DOING its job
+                    # under load, not failing at it: over-quota tenants
+                    # and governor sheds are surfaced for operators and
+                    # by contract never turn readiness red.
+                    body["admission"] = admission
                 self._send(503 if status == "dead" else 200,
                            json.dumps(body, indent=1).encode(),
                            "application/json")
@@ -613,6 +652,20 @@ class AgentHTTPServer:
                     return
                 params = dict(urllib.parse.parse_qsl(url.query))
                 try:
+                    if "tenant" in params:
+                        # `tenant=` shorthand: the admission layer's
+                        # tenant identity as a label selector term
+                        # (runtime/admission.py TENANT_LABEL — the
+                        # same key TenantProvider attaches), validated
+                        # so a malformed value is a 400, not a silent
+                        # empty match.
+                        from parca_agent_tpu.runtime.admission import (
+                            TENANT_LABEL,
+                            validate_tenant,
+                        )
+
+                        params[TENANT_LABEL] = validate_tenant(
+                            params.pop("tenant"))
                     k = int(params.pop("k")) if "k" in params else None
                     scope = params.pop("scope", "local")
                     t0_s = t1_s = None
@@ -666,6 +719,22 @@ class AgentHTTPServer:
                     self._send(400, b"bad timeout parameter\n")
                     return
                 timeout = min(timeout, 60.0)
+                if "tenant" in params:
+                    # Same `tenant=` shorthand as /hotspots: slice the
+                    # live profile stream by the admission layer's
+                    # tenant identity (the TenantProvider label);
+                    # malformed values are a 400.
+                    from parca_agent_tpu.runtime.admission import (
+                        TENANT_LABEL,
+                        validate_tenant,
+                    )
+
+                    try:
+                        params[TENANT_LABEL] = validate_tenant(
+                            params.pop("tenant"))
+                    except ValueError:
+                        self._send(400, b"bad tenant parameter\n")
+                        return
                 want = params
 
                 def match(labels):
@@ -694,6 +763,7 @@ class AgentHTTPServer:
         self.recorder = recorder
         self.hotspots = hotspots
         self.sinks = sinks
+        self.admission = admission
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info
