@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale trace-smoke hotspot-smoke fixtures golden clean install
+.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale bench-regress trace-smoke hotspot-smoke regress-smoke fixtures golden clean install
 
 all: native
 
@@ -38,7 +38,7 @@ test-live:
 # coverage honest (every SITES entry exercised here, and vice versa),
 # so drift fails fast before any test runs.
 chaos: lint
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the
@@ -96,6 +96,16 @@ bench-sinks:
 bench-scale:
 	JAX_PLATFORMS=cpu PARCA_BENCH_SCALE_CHILD=1 $(PYTHON) bench.py
 
+# Regression sentinel acceptance drill (docs/regression.md): a
+# synthetic window stream through the REAL encode pipeline with a 2x
+# hotspot shift injected on one build-id mid-run — detected within <= 2
+# rollup intervals, zero false-positive verdicts across the clean
+# control windows, windows_lost == 0 under regression.fold/baseline
+# chaos, pprof sha256 byte-identity unchanged with the sentinel
+# enabled. Host-bound, so it pins the cpu backend.
+bench-regress:
+	JAX_PLATFORMS=cpu PARCA_BENCH_REGRESS_CHILD=1 $(PYTHON) bench.py
+
 # Hotspot end-to-end smoke (docs/hotspots.md): a short real profiler
 # session (dict aggregator, encode pipeline) must serve human-readable
 # top-K answers on /hotspots, reject bad parameters, expose the rollup
@@ -103,6 +113,16 @@ bench-scale:
 # turning readiness red. Host-bound, so it pins the cpu backend.
 hotspot-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m parca_agent_tpu.tools.hotspot_smoke
+
+# Regression sentinel end-to-end smoke (docs/regression.md): a short
+# real profiler session (hotspots + sentinel + alerts sink + HTTP) must
+# hold a clean control at zero verdicts, turn an injected 10x one-stack
+# shift into exactly one `regressed` verdict on /diff and one JSONL
+# alert record, serve bounded range diffs, reject bad parameters with
+# 400s, and report the regression /metrics//healthz surfaces without
+# turning readiness red. Host-bound, so it pins the cpu backend.
+regress-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m parca_agent_tpu.tools.regress_smoke
 
 # Rebuild the checked-in ELF/DWARF test fixtures and their golden
 # unwind tables (the reference's write-dwarf-unwind-tables pattern,

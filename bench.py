@@ -1820,6 +1820,204 @@ def _hotspot_query() -> dict:
     return phase
 
 
+def _regression_detect() -> dict:
+    """`make bench-regress`: the regression sentinel's acceptance drill
+    (docs/regression.md), host-bound and deterministic.
+
+    A stationary synthetic workload (per-window Poisson noise over a
+    fixed stack population) runs through the REAL encode pipeline three
+    times:
+
+      * arm A (legacy): no sentinel — sha256 of every shipped pprof
+        byte is the identity baseline;
+      * arm B (sentinel): the sentinel rides the rollup hook; after its
+        baseline freezes, >= 30 clean windows must produce ZERO
+        verdicts (the false-positive bar), then a 2x shift injected on
+        ONE build-id must produce a `regressed` verdict on that build
+        within <= 2 rollup intervals — with the pprof sha256 equal to
+        arm A's and zero windows lost;
+      * arm C (chaos): injected ``regression.fold:error`` and
+        ``regression.baseline:error`` faults — every fault counted,
+        ``windows_lost == 0``, sha256 still identical.
+    """
+    import dataclasses
+
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+    from parca_agent_tpu.capture.synthetic import SyntheticSpec, generate
+    from parca_agent_tpu.ops.sketch import CountMinSpec
+    from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+    from parca_agent_tpu.profiler.encode_pipeline import EncodePipeline
+    from parca_agent_tpu.runtime.hotspots import RegistryView
+    from parca_agent_tpu.runtime.regression import (
+        RegressionSentinel,
+        RegressionSpec,
+    )
+    from parca_agent_tpu.utils import faults as faults_mod
+
+    clean_windows = int(os.environ.get("PARCA_BENCH_REGRESS_CLEAN", 40))
+    shifted_windows = int(os.environ.get("PARCA_BENCH_REGRESS_SHIFTED",
+                                         6))
+    rows = int(os.environ.get("PARCA_BENCH_REGRESS_ROWS", 2000))
+    n_pids = int(os.environ.get("PARCA_BENCH_REGRESS_PIDS", 100))
+    baseline_rollups = 5
+    window_s = 10.0
+    base = generate(SyntheticSpec(
+        n_pids=n_pids, n_unique_stacks=rows, n_rows=rows,
+        total_samples=rows * 8, mean_depth=10, kernel_fraction=0.1,
+        seed=17))
+    t0_ns = base.time_ns
+    # The victim build: shared object 1 (synthetic build id 2).
+    lo, hi = 0x0000_7F00_0000_0000, 0x0000_7F00_0000_0000 + (1 << 24)
+    victim_rows = ((base.stacks[:, 0] >= lo)
+                   & (base.stacks[:, 0] < hi))
+    victim_build = f"{2:040x}"
+    shift_at = clean_windows
+    n_windows = clean_windows + shifted_windows
+    # One counts draw per window, shared by every arm (sha identity
+    # requires the arms to ship byte-identical windows).
+    rng = np.random.default_rng(0x51E)
+    window_counts = []
+    for w in range(n_windows):
+        counts = rng.poisson(np.maximum(base.counts, 1)).astype(np.int64)
+        counts = np.maximum(counts, 1)
+        if w >= shift_at:
+            counts[victim_rows] *= 2
+        window_counts.append(counts)
+
+    def spec():
+        return RegressionSpec(
+            interval_s=window_s, baseline_rollups=baseline_rollups,
+            cm=CountMinSpec(depth=4, width=1 << 11))
+
+    def run_arm(sentinel=None, path=None):
+        agg = DictAggregator(
+            capacity=1 << max(14, (4 * rows).bit_length()))
+        sha = hashlib.sha256()
+
+        def ship(out, prep):
+            for _, b in out:
+                sha.update(bytes(b))
+
+        if sentinel is not None:
+            sentinel.path = path
+            pipe = EncodePipeline(
+                WindowEncoder(agg), ship=ship,
+                rollup=lambda prep, ctx:
+                    sentinel.fold_from_prepared(ctx, prep),
+                rollup_capture=lambda prep: RegistryView(agg))
+        else:
+            pipe = EncodePipeline(WindowEncoder(agg), ship=ship)
+        fold_ms = []
+        for w in range(n_windows):
+            s = dataclasses.replace(
+                base, counts=window_counts[w],
+                time_ns=t0_ns + int(w * window_s * 1e9))
+            wc = np.asarray(agg.window_counts(s))
+            assert pipe.submit(wc, s.time_ns, s.window_ns,
+                               s.period_ns) is not None
+            assert pipe.flush(60)
+            if sentinel is not None:
+                fold_ms.append(sentinel.stats["last_fold_s"] * 1e3)
+        assert pipe.close()
+        return sha.hexdigest(), pipe, fold_ms
+
+    # Arm A: legacy, no sentinel.
+    t0 = time.perf_counter()
+    sha_legacy, pipe_a, _ = run_arm()
+    legacy_s = time.perf_counter() - t0
+
+    # Arm B: the sentinel rides.
+    sent = RegressionSentinel(spec=spec())
+    t0 = time.perf_counter()
+    sha_sent, pipe_b, fold_ms = run_arm(sent)
+    sent_s = time.perf_counter() - t0
+    m = sent.metrics()
+    verdicts = sent.verdicts(limit=sent.spec.verdict_ring)["verdicts"]
+    shift_at_s = (t0_ns + shift_at * window_s * 1e9) / 1e9
+    false_pos = [v for v in verdicts if v["t_s"] <= shift_at_s]
+    hits = [v for v in verdicts
+            if v["kind"] == "regressed" and v["build"] == victim_build]
+    detect_latency_s = (min(v["t_s"] for v in hits) - shift_at_s
+                       ) if hits else None
+    judged_clean = clean_windows - baseline_rollups
+
+    # Arm C: chaos — injected fold + baseline-save faults.
+    chaos_dir = tempfile.mkdtemp(prefix="bench-regress-")
+    faults_mod.install(faults_mod.FaultInjector.from_spec(
+        "regression.fold:error:count=3;"
+        "regression.baseline:error:count=2", seed=42))
+    try:
+        sent_c = RegressionSentinel(
+            spec=RegressionSpec(
+                interval_s=window_s, baseline_rollups=baseline_rollups,
+                save_every=5, cm=CountMinSpec(depth=4, width=1 << 11)))
+        sha_chaos, pipe_c, _ = run_arm(
+            sent_c, path=os.path.join(chaos_dir, "baselines.bin"))
+    finally:
+        faults_mod.install(None)
+        import shutil
+
+        shutil.rmtree(chaos_dir, ignore_errors=True)
+    mc = sent_c.metrics()
+
+    identical = sha_sent == sha_legacy
+    chaos_identical = sha_chaos == sha_legacy
+    phase = {
+        "windows": n_windows,
+        "rows": rows,
+        "pids": n_pids,
+        "clean_judged": judged_clean,
+        "shifted_windows": shifted_windows,
+        "bytes_identical": identical,
+        "sha256": sha_legacy[:16],
+        "legacy_wall_s": round(legacy_s, 3),
+        "sentinel_wall_s": round(sent_s, 3),
+        "fold_ms_median": round(_median_ms([v / 1e3 for v in fold_ms]),
+                                3),
+        "fold_ms_max": round(max(fold_ms), 3) if fold_ms else None,
+        "rollups_sealed": m["rollups_sealed"],
+        "baselines_frozen": m["baselines_frozen"],
+        "groups": m["groups"],
+        "false_positive_verdicts": len(false_pos),
+        "detected": bool(hits),
+        "detect_latency_s": (round(detect_latency_s, 1)
+                             if detect_latency_s is not None else None),
+        "detect_bar_s": 2 * window_s,
+        "verdict_counts": m["verdicts"],
+        "windows_lost": pipe_b.stats["windows_lost"],
+        "chaos_bytes_identical": chaos_identical,
+        "chaos_windows_lost": pipe_c.stats["windows_lost"],
+        "chaos_fold_errors": mc["fold_errors"],
+        "chaos_baseline_save_errors": mc["baseline_save_errors"],
+    }
+    if not identical:
+        phase["error"] = ("pprof bytes with the sentinel enabled differ "
+                          "from the legacy ship path")
+    elif judged_clean < 30:
+        phase["error"] = (f"only {judged_clean} clean judged windows "
+                          "(bar: >= 30)")
+    elif false_pos:
+        phase["error"] = (f"{len(false_pos)} false-positive verdicts "
+                          f"across {judged_clean} clean windows")
+    elif not hits:
+        phase["error"] = ("the injected 2x shift on one build-id was "
+                          "never detected")
+    elif detect_latency_s > 2 * window_s:
+        phase["error"] = (f"detection took {detect_latency_s:.0f}s > 2 "
+                          f"rollup intervals ({2 * window_s:.0f}s)")
+    elif pipe_b.stats["windows_lost"] or pipe_c.stats["windows_lost"]:
+        phase["error"] = "a sentinel arm lost a window"
+    elif not chaos_identical:
+        phase["error"] = ("injected regression.* faults disturbed the "
+                          "pprof ship")
+    elif mc["fold_errors"] != 3 or mc["baseline_save_errors"] != 2:
+        phase["error"] = ("injected regression.* faults were not all "
+                          "counted (fold "
+                          f"{mc['fold_errors']}/3, save "
+                          f"{mc['baseline_save_errors']}/2)")
+    return phase
+
+
 def _sink_fanout() -> dict:
     """`make bench-sinks`: the output-backend subsystem's acceptance
     drill (docs/sinks.md), host-bound and deterministic.
@@ -2272,6 +2470,21 @@ def _scale_main() -> None:
     print(json.dumps({"metric": "scale_sweep", **phase}))
 
 
+def _regress_main() -> None:
+    """`make bench-regress`: the regression sentinel drill alone, one
+    JSON line. Host-bound (pipeline + sentinel are pure host work)."""
+    try:
+        phase = _regression_detect()
+    except Exception as e:  # noqa: BLE001 - the line must still print
+        phase = {"error": repr(e)[:300]}
+    import jax
+
+    phase["backend"] = jax.default_backend()
+    _finalize_result(phase, device_alive=True,
+                     require_full_scale=False, require_device=False)
+    print(json.dumps({"metric": "regression_detect", **phase}))
+
+
 def _hotspot_main() -> None:
     """`make bench-hotspot`: the hotspot rollup drill alone, one JSON
     line. Numpy-only — the backend stamp just records the pin."""
@@ -2316,6 +2529,9 @@ def main() -> None:
         return
     if os.environ.get("PARCA_BENCH_SINK_CHILD"):
         _sink_main()
+        return
+    if os.environ.get("PARCA_BENCH_REGRESS_CHILD"):
+        _regress_main()
         return
     if os.environ.get("PARCA_BENCH_SCALE_CHILD"):
         _scale_main()

@@ -279,6 +279,57 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hotspot-stale-after", type=float, default=60.0,
                    help="seconds without a completed fleet merge round "
                         "before fleet-scope answers are flagged stale")
+    p.add_argument("--regression", action="store_true",
+                   help="run the regression sentinel "
+                        "(docs/regression.md): every shipped window is "
+                        "attributed by (leaf build-id, tenant) and "
+                        "folded into 1-minute rollups that are diffed "
+                        "against frozen content-addressed baselines — "
+                        "new_hotspot/regressed/improved/drifted "
+                        "verdicts on /diff, JSONL alert records via "
+                        "--sink alerts, and AutoFDO profdata staleness "
+                        "marks on drift. Needs --hotspots (the "
+                        "sentinel rides the same worker-thread fold "
+                        "clock and serves range diffs from the rollup "
+                        "levels)")
+    p.add_argument("--regression-interval", type=float, default=60.0,
+                   help="rollup bucket span in seconds — the judgment "
+                        "cadence (a shift is detectable within two "
+                        "intervals)")
+    p.add_argument("--regression-baseline-windows", type=int, default=5,
+                   help="sealed rollups frozen into a group's baseline "
+                        "before judgment starts")
+    p.add_argument("--regression-path", default="",
+                   help="crash-only baseline persistence file "
+                        "(tmp+rename, CRC-framed, content-digest-"
+                        "checked; adopted at startup so a restart "
+                        "resumes judging instead of relearning). "
+                        "Empty = in-memory only")
+    p.add_argument("--regression-sigma", type=float, default=4.0,
+                   help="noise-floor multiplier a shift must clear "
+                        "(the floor is learned per key from rollup-to-"
+                        "rollup variance)")
+    p.add_argument("--regression-min-count", type=int, default=16,
+                   help="absolute per-rollup sample-count floor below "
+                        "which no verdict fires")
+    p.add_argument("--regression-min-ratio", type=float, default=1.5,
+                   help="relative shift (current/baseline) a "
+                        "regressed/improved verdict must clear")
+    p.add_argument("--regression-drift-threshold", type=float,
+                   default=0.5,
+                   help="EWMA-smoothed distribution distance past "
+                        "which a build's profile is judged drifted and "
+                        "its AutoFDO profdata marked stale")
+    p.add_argument("--regression-max-groups", type=int, default=256,
+                   help="bounded (build-id, tenant) judgment groups; "
+                        "rows past the cap are counted, not judged")
+    p.add_argument("--regression-max-keys", type=int, default=4096,
+                   help="exact stack keys tracked per group; past it "
+                        "the count-min backstop carries the mass")
+    p.add_argument("--alerts-path", default="",
+                   help="JSONL verdict record file for the alerts sink "
+                        "(crash-only appends, .1 rotation). Required "
+                        "when --sink includes alerts")
     p.add_argument("--sink", default="pprof",
                    help="comma-separated output backends for shipped "
                         "windows (docs/sinks.md): pprof (the store ship "
@@ -286,10 +337,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "LLVM profdata-text PGO profiles keyed by "
                         "build-id, --autofdo-* flags), series (scalar "
                         "OTLP-style per-label-set sample-count series "
-                        "on /metrics). Secondary sinks are fail-open: "
-                        "their failures are counted and can never "
-                        "delay or drop the pprof ship. autofdo/series "
-                        "need --fast-encode")
+                        "on /metrics), alerts (crash-only JSONL "
+                        "regression verdict records, needs "
+                        "--regression and --alerts-path). Secondary "
+                        "sinks are fail-open: their failures are "
+                        "counted and can never delay or drop the "
+                        "pprof ship. Secondaries need --fast-encode")
     p.add_argument("--autofdo-dir", default="",
                    help="directory for the AutoFDO sink's per-binary "
                         "profdata-text profiles (<build-id>.afdo.txt, "
@@ -956,16 +1009,57 @@ def run(argv=None) -> int:
             if fleet_merger is not None:
                 fleet_merger.attach_hotspots(hotspot_store)
 
+    # -- regression sentinel (docs/regression.md) ----------------------------
+    # The judgment layer over the rollup hierarchy: per-(build-id,
+    # tenant) 1-minute rollups diffed against frozen content-addressed
+    # baselines on the encode worker, verdicts on /diff and (via the
+    # alerts sink) as crash-only JSONL, AutoFDO staleness marks on
+    # drift.
+    regression_sentinel = None
+    if args.regression:
+        if hotspot_store is None:
+            log.warn("--regression needs --hotspots (the sentinel rides "
+                     "the rollup fold clock); regression sentinel "
+                     "disabled")
+        else:
+            from parca_agent_tpu.ops.sketch import (
+                CountMinSpec as _RegCMSpec,
+            )
+            from parca_agent_tpu.runtime.regression import (
+                RegressionSentinel,
+                RegressionSpec,
+            )
+
+            try:
+                regression_sentinel = RegressionSentinel(
+                    spec=RegressionSpec(
+                        interval_s=args.regression_interval,
+                        baseline_rollups=args.regression_baseline_windows,
+                        k_sigma=args.regression_sigma,
+                        min_count=args.regression_min_count,
+                        min_ratio=args.regression_min_ratio,
+                        drift_threshold=args.regression_drift_threshold,
+                        max_groups=args.regression_max_groups,
+                        max_keys=args.regression_max_keys,
+                        cm=_RegCMSpec(depth=args.hotspot_cm_depth,
+                                      width=args.hotspot_cm_width)),
+                    path=args.regression_path or None)
+            except ValueError as e:
+                # The spec validates (interval > 0, sigma > 0, power-of-
+                # two sketch width...): an operator typo should be a
+                # readable startup error, not a traceback.
+                raise SystemExit(f"bad --regression-* flags: {e}")
+
     # -- output-backend sinks (docs/sinks.md) --------------------------------
     # --sink pprof,autofdo,series: each shipped window fans out to every
     # configured backend; pprof is the primary ship path (byte-identical
     # to the pre-sink writer route) and the secondaries are fail-open.
     sink_names = [s.strip() for s in args.sink.split(",") if s.strip()]
     unknown = [s for s in sink_names if s not in ("pprof", "autofdo",
-                                                  "series")]
+                                                  "series", "alerts")]
     if unknown:
         raise SystemExit(f"unknown --sink backend(s) {unknown!r} "
-                         "(want pprof, autofdo, series)")
+                         "(want pprof, autofdo, series, alerts)")
     if "pprof" not in sink_names:
         raise SystemExit("--sink must include pprof: it is the agent's "
                          "ship path (secondaries ride beside it)")
@@ -976,8 +1070,10 @@ def run(argv=None) -> int:
                  "prepared windows); secondary sinks disabled")
         secondary_names = []
     sink_registry = None
+    autofdo_sink = None
     if secondary_names:
         from parca_agent_tpu.sinks import (
+            AlertsSink,
             AutoFDOSink,
             PprofSink,
             SeriesSink,
@@ -990,14 +1086,28 @@ def run(argv=None) -> int:
                 raise SystemExit("--sink autofdo needs --autofdo-dir")
             if args.autofdo_flush_windows < 1:
                 raise SystemExit("--autofdo-flush-windows must be >= 1")
-            sink_list.append(AutoFDOSink(
+            autofdo_sink = AutoFDOSink(
                 args.autofdo_dir,
                 flush_windows=args.autofdo_flush_windows,
                 max_binaries=args.autofdo_max_binaries,
-                max_offsets=args.autofdo_max_offsets))
+                max_offsets=args.autofdo_max_offsets)
+            sink_list.append(autofdo_sink)
         if "series" in secondary_names:
             sink_list.append(SeriesSink(max_sets=args.series_max_sets))
+        if "alerts" in secondary_names:
+            if not args.alerts_path:
+                raise SystemExit("--sink alerts needs --alerts-path")
+            if regression_sentinel is None:
+                raise SystemExit("--sink alerts needs --regression "
+                                 "(with --hotspots): the alerts sink "
+                                 "drains the sentinel's verdicts")
+            sink_list.append(AlertsSink(args.alerts_path,
+                                        sentinel=regression_sentinel))
         sink_registry = SinkRegistry(sink_list)
+    if regression_sentinel is not None and autofdo_sink is not None:
+        # Close the PGO loop: a drifted build's profdata gets a crash-
+        # only .stale marker so downstream consumers refresh.
+        regression_sentinel.bind_staleness(autofdo_sink.mark_stale)
     profiler = CPUProfiler(
         source=source,
         aggregator=aggregator,
@@ -1028,6 +1138,7 @@ def run(argv=None) -> int:
         trace_recorder=recorder,
         hotspot_store=hotspot_store,
         sinks=sink_registry,
+        regression=regression_sentinel,
     )
 
     if statics_store is not None and profiler._encoder is not None:
@@ -1156,7 +1267,8 @@ def run(argv=None) -> int:
                            recorder=recorder,
                            hotspots=hotspot_store,
                            sinks=sink_registry,
-                           admission=admission)
+                           admission=admission,
+                           regression=regression_sentinel)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None

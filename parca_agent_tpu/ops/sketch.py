@@ -104,6 +104,20 @@ def cm_merge(a, b):
     return a + b
 
 
+def cm_sub(a, b):
+    """Subtract table ``b`` from table ``a`` (same spec). Because the
+    structure is linear, ``cm_sub(cm_merge(ta, tb), tb)`` is elementwise
+    identical to ``ta`` — so point queries on the difference table keep
+    the one-sided guarantee over the stream that built ``ta``: never an
+    underestimate, overestimate <= epsilon * remaining-total per row.
+    When ``b`` was NOT merged into ``a`` (two independent streams — the
+    regression sentinel's rollup-vs-baseline diff), per-cell values can
+    go negative and a point query bounds the true count difference
+    within +/- epsilon * (total_a + total_b); callers must propagate
+    that two-sided bound (runtime/regression.py does)."""
+    return a - b
+
+
 def cm_add(table, hashes, counts, spec: CountMinSpec) -> None:
     """Accumulate an item stream into an EXISTING host table in place
     (numpy only). The streaming twin of cm_build for long-lived tables —

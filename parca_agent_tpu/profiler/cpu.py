@@ -93,6 +93,7 @@ class CPUProfiler:
         trace_recorder=None,
         hotspot_store=None,
         sinks=None,
+        regression=None,
     ):
         self._source = source
         self._aggregator = aggregator
@@ -162,6 +163,16 @@ class CPUProfiler:
         if hotspot_store is not None and labels_manager is not None \
                 and hotspot_store.labels_for is None:
             hotspot_store.labels_for = self._locked_labels_for
+        # Regression sentinel (runtime/regression.py): the judgment
+        # rider on the same worker-thread fold clock — each shipped
+        # window is attributed by (leaf build-id, tenant) and diffed
+        # against frozen baselines. Fail-open inside the sentinel
+        # itself (counted fold_errors), so it shares the rollup hook
+        # without changing the hotspot fold's re-raise contract.
+        self._regression = regression
+        if regression is not None and labels_manager is not None \
+                and regression.labels_for is None:
+            regression.labels_for = self._locked_labels_for
         # Output-backend sinks (sinks/, docs/sinks.md): the registry
         # replaces the hardwired pprof ship with a fan-out whose primary
         # (pprof) IS the pre-sink write path bound below — bytes stay
@@ -200,9 +211,11 @@ class CPUProfiler:
                 snapshot_every=(statics_snapshot_every
                                 if statics_store is not None else 0),
                 rollup=(self._rollup_window
-                        if hotspot_store is not None else None),
+                        if hotspot_store is not None
+                        or regression is not None else None),
                 rollup_capture=(self._rollup_capture
-                                if hotspot_store is not None else None),
+                                if hotspot_store is not None
+                                or regression is not None else None),
                 # The sink context is the same rotation-consistent
                 # RegistryView the rollup capture produces; reusing the
                 # hook keeps one definition of "safe to read off-thread".
@@ -216,6 +229,9 @@ class CPUProfiler:
             if hotspot_store is not None:
                 _log.warn("hotspot rollups need the encode pipeline; "
                           "windows will not be folded")
+            if regression is not None:
+                _log.warn("the regression sentinel needs the encode "
+                          "pipeline; windows will not be judged")
         self._encode_deadline = encode_deadline_s
         self._encode_inflight = None   # abandoned inline deadline encode
         self._encode_abandoned = None  # its result box (error inspection)
@@ -655,9 +671,20 @@ class CPUProfiler:
     def _rollup_window(self, prep, ctx) -> None:
         """EncodePipeline rollup hook (worker thread): fold the shipped
         window's live (id, count) rows into the hotspot store, reading
-        per-id state only through the hand-off-time registry view."""
-        self._hotspots.fold_from_aggregator(
-            ctx, prep.idx, prep.vals, prep.time_ns, prep.duration_ns)
+        per-id state only through the hand-off-time registry view; then
+        hand the same view to the regression sentinel. The sentinel
+        rides in the finally arm (its fold is internally fail-open and
+        never raises), so a hotspot fold failure — which must propagate
+        for the pipeline's rollup_errors counter — cannot starve the
+        window's judgment."""
+        try:
+            if self._hotspots is not None:
+                self._hotspots.fold_from_aggregator(
+                    ctx, prep.idx, prep.vals, prep.time_ns,
+                    prep.duration_ns)
+        finally:
+            if self._regression is not None:
+                self._regression.fold_from_prepared(ctx, prep)
 
     def _write_one(self, pid: int, payload) -> bool:
         """Labels lookup + write + bookkeeping for one profile; False when

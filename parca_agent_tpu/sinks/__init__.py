@@ -7,6 +7,7 @@ and byte-identical to the pre-sink path; AutoFDO/PGO profdata-text and
 scalar OTLP-style series emitters ride beside it.
 """
 
+from parca_agent_tpu.sinks.alerts import AlertsSink
 from parca_agent_tpu.sinks.autofdo import AutoFDOSink
 from parca_agent_tpu.sinks.base import Sink, SinkWindow
 from parca_agent_tpu.sinks.pprof import PprofSink
@@ -14,6 +15,7 @@ from parca_agent_tpu.sinks.registry import SinkRegistry
 from parca_agent_tpu.sinks.series import SeriesSink
 
 __all__ = [
+    "AlertsSink",
     "AutoFDOSink",
     "PprofSink",
     "SeriesSink",

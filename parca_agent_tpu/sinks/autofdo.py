@@ -60,8 +60,22 @@ from parca_agent_tpu.utils.vfs import atomic_write_bytes
 _log = get_logger("sink-autofdo")
 
 _SUFFIX = ".afdo.txt"
+_STALE_SUFFIX = ".stale"
 _SAFE_KEY = re.compile(r"[^0-9a-zA-Z._-]")
 _BODY_RE = re.compile(r"^ 0x([0-9a-f]+): (\d+)$")
+
+
+def binary_key(mapping) -> str:
+    """Stable per-binary key: the build id (filesystem-safe), else a
+    content hash of the path so same-named binaries from different
+    images never merge. Shared with the regression sentinel
+    (runtime/regression.py) so drift verdicts and profdata files agree
+    on the binary's identity."""
+    if mapping.build_id:
+        return _SAFE_KEY.sub("_", mapping.build_id)
+    digest = hashlib.blake2b((mapping.path or "?").encode(),
+                             digest_size=16).hexdigest()
+    return f"p-{digest}"
 
 
 class _Binary:
@@ -137,6 +151,7 @@ class AutoFDOSink:
             "bytes": 0,             # profdata bytes written (crash-only)
             "files_adopted": 0,
             "adopt_errors": 0,
+            "stale_marked": 0,      # regression-sentinel staleness marks
         }
         os.makedirs(directory, exist_ok=True)
         if adopt:
@@ -175,11 +190,7 @@ class AutoFDOSink:
     # -- fold path (registry-serialized) -------------------------------------
 
     def _key_for(self, mapping) -> str:
-        if mapping.build_id:
-            return _SAFE_KEY.sub("_", mapping.build_id)
-        digest = hashlib.blake2b((mapping.path or "?").encode(),
-                                 digest_size=16).hexdigest()
-        return f"p-{digest}"
+        return binary_key(mapping)
 
     def emit(self, win) -> None:
         # The flush cadence ticks on EVERY emit — including skipped and
@@ -274,6 +285,24 @@ class AutoFDOSink:
             self.stats["flushes"] += 1
         if first_err is not None:
             raise first_err
+
+    def mark_stale(self, key: str) -> None:
+        """Regression-sentinel staleness signal (runtime/regression.py
+        drift verdicts): drop a crash-only ``<key>.stale`` marker beside
+        the binary's profdata and count it, so a downstream PGO consumer
+        knows the emitted profile no longer matches the live behavior
+        and must refresh rather than trust it ("From Profiling to
+        Optimization", arxiv 2507.16649 — stale profiles actively hurt).
+        The marker persists until the consumer removes it; later flushes
+        keep updating the profdata beside it. May raise (disk): the
+        sentinel's counted fail-open hook guard owns the failure. Runs
+        on the encode worker — the same thread pipelined emits run on,
+        and a distinct file from any flush target, so no write can tear."""
+        safe = _SAFE_KEY.sub("_", key)
+        atomic_write_bytes(
+            os.path.join(self._dir, safe + _STALE_SUFFIX),
+            b"stale: profile drift exceeded threshold\n")
+        self.stats["stale_marked"] += 1
 
     def close(self) -> None:
         self.flush()

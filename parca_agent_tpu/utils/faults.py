@@ -47,6 +47,17 @@ layer the ship-path components consult at NAMED SITES:
                       fault is counted (shed_errors) and costs this
                       window's shed step only; quotas and windows are
                       untouched
+    regression.fold   one window's fold into the regression sentinel's
+                      rollup groups (runtime/regression.py) — fail-open
+                      like the hotspot fold: an injected fault is
+                      counted (fold_errors) and costs that window's
+                      judgment, never the window or the pprof ship
+    regression.baseline
+                      the sentinel's baseline persistence (save on the
+                      encode worker, adopt at startup) — counted
+                      (baseline_save_errors / baseline_adopt_errors)
+                      and skipped: the sentinel relearns cold, the
+                      agent is unharmed
 
 and, on the ingest side (docs/robustness.md "ingest containment" — the
 ``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an
@@ -135,6 +146,9 @@ SITES = {
     "sink.flush": "AutoFDO profdata crash-only rewrite (sinks/autofdo.py)",
     "admission.resolve": "pid -> tenant resolution (runtime/admission.py)",
     "admission.shed": "overload-governor shed step (runtime/admission.py)",
+    "regression.fold": "regression sentinel fold (runtime/regression.py)",
+    "regression.baseline":
+        "sentinel baseline save/adopt (runtime/regression.py)",
     "elf.read": "ElfFile construction (elf/reader.py)",
     "perfmap.parse": "JIT perf-map read+parse (symbolize/perfmap.py)",
     "maps.parse": "/proc/<pid>/maps parse (process/maps.py)",

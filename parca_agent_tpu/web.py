@@ -16,8 +16,83 @@ import html
 import json
 import math
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+# -- shared query-parameter validation ----------------------------------------
+# /query, /hotspots, and /diff grew the same hygiene in parallel across
+# PRs (timeout clamping, float finiteness, the `tenant=` selector): one
+# helper set now owns it. The contract every helper keeps: a malformed
+# value raises ValueError and the HANDLER turns it into a 400 — never a
+# dropped connection, never a 500.
+
+
+def pop_float(params: dict, name: str, default=None):
+    """One FINITE float query parameter, popped. ?t0=inf (or a value
+    whose later *1e9 would overflow int conversion) must be a 400."""
+    if name not in params:
+        return default
+    v = float(params.pop(name))
+    if not math.isfinite(v):
+        raise ValueError(f"non-finite {name}")
+    return v
+
+
+def pop_timeout(params: dict, default: float = 15.0,
+                cap: float = 60.0) -> float:
+    """timeout= with the [0, cap] clamp: a huge (or NaN/inf) timeout
+    used to park a server thread on the listener indefinitely —
+    negative/non-finite is a caller bug (ValueError -> 400), anything
+    past the cap is capped, not honored."""
+    t = pop_float(params, "timeout", default)
+    if t < 0:
+        raise ValueError("negative timeout")
+    return min(t, cap)
+
+
+def pop_tenant(params: dict) -> None:
+    """`tenant=` shorthand: the admission layer's tenant identity as a
+    label selector term (runtime/admission.py TENANT_LABEL — the same
+    key TenantProvider attaches), validated in place so a malformed
+    value is a 400, not a silent empty match."""
+    if "tenant" not in params:
+        return
+    from parca_agent_tpu.runtime.admission import (
+        TENANT_LABEL,
+        validate_tenant,
+    )
+
+    params[TENANT_LABEL] = validate_tenant(params.pop("tenant"))
+
+
+def pop_time_range(params: dict) -> tuple:
+    """?range=S (seconds back from now) or explicit ?t0=/?t1= (unix
+    seconds) -> (t0_s, t1_s), either side None when unconstrained."""
+    t0_s = t1_s = None
+    rng = pop_float(params, "range")
+    if rng is not None:
+        if rng <= 0:
+            raise ValueError("range must be > 0")
+        t1_s = time.time()
+        t0_s = t1_s - rng
+    v = pop_float(params, "t0")
+    if v is not None:
+        t0_s = v
+    v = pop_float(params, "t1")
+    if v is not None:
+        t1_s = v
+    return t0_s, t1_s
+
+
+def pop_k_scope(params: dict) -> tuple:
+    """?k= / ?scope=local|fleet for the rollup-backed endpoints."""
+    k = int(params.pop("k")) if "k" in params else None
+    scope = params.pop("scope", "local")
+    if (k is not None and k < 1) or scope not in ("local", "fleet"):
+        raise ValueError("bad k/scope")
+    return k, scope
 
 
 def render_status_page(profilers, version: str = "dev",
@@ -134,7 +209,7 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    supervisor=None, quarantine=None,
                    device_health=None, statics_store=None,
                    recorder=None, hotspots=None, sinks=None,
-                   admission=None) -> str:
+                   admission=None, regression=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics and
     the window flight recorder's stage histograms
@@ -374,6 +449,31 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
             emit(f"parca_agent_admission_{k}", v)
         for k, v in m["resolver"].items():
             emit(f"parca_agent_tenant_{k}", v)
+    if regression is not None:
+        # Regression sentinel (docs/regression.md): verdict counters by
+        # kind, the fold/seal/baseline lifecycle counters, judgment
+        # state gauges (groups, frozen baselines, worst drift), and the
+        # crash-only persistence + staleness-mark accounting.
+        m = regression.metrics()
+        for kind, n in sorted(m.pop("verdicts").items()):
+            emit("parca_agent_regression_verdicts_total", n,
+                 {"kind": kind})
+        for k in ("windows_folded", "windows_skipped", "fold_errors",
+                  "rollups_sealed", "groups_dropped", "keys_overflow",
+                  "rows_dropped", "verdicts_suppressed",
+                  "alerts_dropped", "baselines_frozen",
+                  "baseline_saves", "baseline_save_errors",
+                  "baselines_adopted", "baseline_adopt_errors",
+                  "stale_marks", "stale_mark_errors", "queries",
+                  "query_errors"):
+            emit(f"parca_agent_regression_{k}_total", m[k])
+        emit("parca_agent_regression_groups", m["groups"])
+        emit("parca_agent_regression_baselines", m["baselines"])
+        emit("parca_agent_regression_alerts_pending",
+             m["alerts_pending"])
+        emit("parca_agent_regression_drift_max", m["drift_max"])
+        emit("parca_agent_regression_last_fold_seconds",
+             round(m["last_fold_s"], 6))
     if sinks is not None:
         # Output-backend sinks (docs/sinks.md): the contract trio —
         # windows/bytes/errors per sink — as labeled families, every
@@ -420,7 +520,8 @@ class AgentHTTPServer:
                  version: str = "dev", extra_metrics=None,
                  capture_info=None, supervisor=None, quarantine=None,
                  device_health=None, statics_store=None, recorder=None,
-                 hotspots=None, sinks=None, admission=None):
+                 hotspots=None, sinks=None, admission=None,
+                 regression=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -452,7 +553,8 @@ class AgentHTTPServer:
                         recorder=outer.recorder,
                         hotspots=outer.hotspots,
                         sinks=outer.sinks,
-                        admission=outer.admission).encode())
+                        admission=outer.admission,
+                        regression=outer.regression).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
@@ -461,6 +563,8 @@ class AgentHTTPServer:
                     self._query(url)
                 elif url.path == "/hotspots":
                     self._hotspots(url)
+                elif url.path == "/diff":
+                    self._diff(url)
                 elif url.path == "/debug/windows":
                     self._debug_windows(url)
                 elif url.path.startswith("/debug/trace/"):
@@ -570,6 +674,8 @@ class AgentHTTPServer:
                          if outer.sinks is not None else None)
                 admission = (outer.admission.snapshot()
                              if outer.admission is not None else None)
+                regression = (outer.regression.snapshot()
+                              if outer.regression is not None else None)
                 if outer.supervisor is None:
                     body = {"status": "healthy", "actors": {}}
                     if quarantine is not None:
@@ -584,6 +690,8 @@ class AgentHTTPServer:
                         body["sinks"] = sinks
                     if admission is not None:
                         body["admission"] = admission
+                    if regression is not None:
+                        body["regression"] = regression
                     self._send(200, json.dumps(body).encode(),
                                "application/json")
                     return
@@ -627,6 +735,13 @@ class AgentHTTPServer:
                     # and governor sheds are surfaced for operators and
                     # by contract never turn readiness red.
                     body["admission"] = admission
+                if regression is not None:
+                    # Regression verdicts are judgments about the
+                    # PROFILED WORKLOAD, not about the agent: a fleet of
+                    # regressed binaries (or a failed baseline save) is
+                    # surfaced for operators and by contract never
+                    # turns readiness red.
+                    body["regression"] = regression
                 self._send(503 if status == "dead" else 200,
                            json.dumps(body, indent=1).encode(),
                            "application/json")
@@ -652,45 +767,12 @@ class AgentHTTPServer:
                     return
                 params = dict(urllib.parse.parse_qsl(url.query))
                 try:
-                    if "tenant" in params:
-                        # `tenant=` shorthand: the admission layer's
-                        # tenant identity as a label selector term
-                        # (runtime/admission.py TENANT_LABEL — the
-                        # same key TenantProvider attaches), validated
-                        # so a malformed value is a 400, not a silent
-                        # empty match.
-                        from parca_agent_tpu.runtime.admission import (
-                            TENANT_LABEL,
-                            validate_tenant,
-                        )
-
-                        params[TENANT_LABEL] = validate_tenant(
-                            params.pop("tenant"))
-                    k = int(params.pop("k")) if "k" in params else None
-                    scope = params.pop("scope", "local")
-                    t0_s = t1_s = None
-                    if "range" in params:
-                        import time as _time
-
-                        rng = float(params.pop("range"))
-                        if not math.isfinite(rng) or rng <= 0:
-                            raise ValueError("bad range")
-                        t1_s = _time.time()
-                        t0_s = t1_s - rng
-                    if "t0" in params:
-                        t0_s = float(params.pop("t0"))
-                    if "t1" in params:
-                        t1_s = float(params.pop("t1"))
-                    for t in (t0_s, t1_s):
-                        # Same finiteness discipline as ?range= and
-                        # _query's timeout: ?t0=inf (or a float whose
-                        # *1e9 overflows int conversion) must be a 400,
-                        # not a dropped connection.
-                        if t is not None and not math.isfinite(t):
-                            raise ValueError("non-finite t0/t1")
-                    if (k is not None and k < 1) \
-                            or scope not in ("local", "fleet"):
-                        raise ValueError("bad k/scope")
+                    # Shared hygiene (module helpers): tenant selector
+                    # validation, float finiteness, k/scope — the same
+                    # gates /query and /diff ride.
+                    pop_tenant(params)
+                    k, scope = pop_k_scope(params)
+                    t0_s, t1_s = pop_time_range(params)
                     body = outer.hotspots.query(
                         k=k, t0_s=t0_s, t1_s=t1_s, selector=params,
                         scope=scope)
@@ -701,40 +783,77 @@ class AgentHTTPServer:
                 self._send(200, json.dumps(body, indent=1).encode(),
                            "application/json")
 
+            def _diff(self, url):
+                """The regression sentinel's read surface
+                (docs/regression.md). Two modes:
+
+                  * default — recent verdicts + per-group judgment
+                    state (?tenant=, ?build=, ?kind=, ?since=,
+                    ?limit=);
+                  * range diff — ?a0=&a1=&b0=&b1= (unix seconds):
+                    range A minus range B computed over the hotspot
+                    store's rollup levels (?k=, ?scope=local|fleet,
+                    label selector terms), every entry carrying
+                    exact/estimate bounds.
+
+                Parameter hygiene rides the same shared helpers as
+                /query and /hotspots; malformed values are 400s."""
+                if outer.regression is None:
+                    self._send(503, b"regression sentinel not enabled\n")
+                    return
+                params = dict(urllib.parse.parse_qsl(url.query))
+                try:
+                    pop_tenant(params)
+                    bounds = [pop_float(params, n)
+                              for n in ("a0", "a1", "b0", "b1")]
+                    if any(b is not None for b in bounds):
+                        if any(b is None for b in bounds):
+                            raise ValueError(
+                                "a range diff needs all of a0,a1,b0,b1")
+                        if outer.hotspots is None:
+                            self._send(503, b"range diff needs hotspot "
+                                            b"rollups\n")
+                            return
+                        k, scope = pop_k_scope(params)
+                        body = outer.regression.diff_ranges(
+                            outer.hotspots, *bounds, k=k,
+                            selector=params, scope=scope)
+                    else:
+                        since = pop_float(params, "since")
+                        limit = int(params.pop("limit", "100"))
+                        if limit < 1:
+                            raise ValueError("limit must be >= 1")
+                        tenant = params.pop("tenant", None)
+                        build = params.pop("build", None)
+                        kind = params.pop("kind", None)
+                        if params:
+                            # Unlike the selector-consuming range mode,
+                            # verdict mode has a closed parameter set —
+                            # a typo'd filter must be a 400, not an
+                            # unfiltered 200 that reads as "no match".
+                            raise ValueError(
+                                f"unknown parameters {sorted(params)}")
+                        body = outer.regression.verdicts(
+                            tenant=tenant, build=build, kind=kind,
+                            since_s=since, limit=limit)
+                except (ValueError, TypeError, OverflowError) as e:
+                    outer.regression.count_query_error()
+                    self._send(400, f"bad diff query: {e}\n".encode())
+                    return
+                self._send(200, json.dumps(body, indent=1).encode(),
+                           "application/json")
+
             def _query(self, url):
                 if outer.listener is None:
                     self._send(503, b"no listener\n")
                     return
                 params = dict(urllib.parse.parse_qsl(url.query))
                 try:
-                    timeout = float(params.pop("timeout", "15"))
-                except ValueError:
-                    self._send(400, b"bad timeout parameter\n")
+                    timeout = pop_timeout(params)
+                    pop_tenant(params)
+                except (ValueError, TypeError) as e:
+                    self._send(400, f"bad query parameter: {e}\n".encode())
                     return
-                # Clamp to [0, 60]: a huge (or NaN/inf) timeout used to
-                # park a server thread on the listener indefinitely —
-                # negative/non-finite is a caller bug (400), anything
-                # past a minute is capped, not honored.
-                if not math.isfinite(timeout) or timeout < 0:
-                    self._send(400, b"bad timeout parameter\n")
-                    return
-                timeout = min(timeout, 60.0)
-                if "tenant" in params:
-                    # Same `tenant=` shorthand as /hotspots: slice the
-                    # live profile stream by the admission layer's
-                    # tenant identity (the TenantProvider label);
-                    # malformed values are a 400.
-                    from parca_agent_tpu.runtime.admission import (
-                        TENANT_LABEL,
-                        validate_tenant,
-                    )
-
-                    try:
-                        params[TENANT_LABEL] = validate_tenant(
-                            params.pop("tenant"))
-                    except ValueError:
-                        self._send(400, b"bad tenant parameter\n")
-                        return
                 want = params
 
                 def match(labels):
@@ -764,6 +883,7 @@ class AgentHTTPServer:
         self.hotspots = hotspots
         self.sinks = sinks
         self.admission = admission
+        self.regression = regression
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info

@@ -19,5 +19,46 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# -- requires_shard_map: one switch for the sharded/fleet test sets ----------
+# The mesh-sharded aggregator, the fleet merge programs, and the
+# cross-process collective tests are all written against the unified
+# `jax.shard_map` entry point; environments pinned to a jax that only
+# ships the experimental spelling cannot run them at all. That is an
+# ENVIRONMENT property, not a code failure — report those tests as
+# skips (with the reason on each), so a tier-1 run reads signal, not
+# 20+ known-env red lines. The marker is also available for explicit
+# use on new shard_map-dependent tests.
+HAVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+requires_shard_map = pytest.mark.skipif(
+    not HAVE_SHARD_MAP,
+    reason="this jax build has no jax.shard_map (sharded/fleet sets "
+           "need the unified entry point)")
+
+# Whole modules that exist to exercise shard_map programs, plus the
+# mixed modules whose "sharded"-named cases drive the ShardedDict
+# aggregator (test_dict_fuzz's sharded differential slice,
+# test_window_encoder's [NN-sharded] params, test_streaming's
+# sharded-feeder case). The name fragment applies ONLY inside those
+# mixed modules — test_walker's numpy-only ShardedTable tests, for
+# example, have no shard_map dependency and must keep running.
+_SHARD_MAP_MODULES = frozenset(
+    ("test_aggregator_sharded", "test_fleet", "test_distributed"))
+_SHARD_MAP_MIXED_MODULES = frozenset(
+    ("test_dict_fuzz", "test_window_encoder", "test_streaming"))
+_SHARD_MAP_NAME_FRAGMENT = "sharded"
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.get_closest_marker("requires_shard_map") is None:
+            mod = item.module.__name__
+            if mod not in _SHARD_MAP_MODULES \
+                    and not (mod in _SHARD_MAP_MIXED_MODULES
+                             and _SHARD_MAP_NAME_FRAGMENT in item.name):
+                continue
+        item.add_marker(requires_shard_map)

@@ -17,6 +17,7 @@ from parca_agent_tpu.ops.sketch import (
     cm_build,
     cm_merge,
     cm_query,
+    cm_sub,
     hll_build,
     hll_estimate,
     hll_merge,
@@ -62,6 +63,68 @@ def test_cm_device_matches_host():
     host = cm_build(hashes, counts, spec)
     dev = np.asarray(cm_build(jnp.asarray(hashes), jnp.asarray(counts), spec))
     assert np.array_equal(host, dev)
+
+
+def test_cm_sub_of_merge_recovers_exact_table():
+    """Linearity property the regression sentinel's baseline diff rides:
+    cm_sub(cm_merge(ta, tb), tb) is ELEMENTWISE identical to ta — so a
+    point query on the subtracted table preserves the one-sided
+    guarantee over stream A (never an underestimate of A's true
+    counts), no matter what stream B was folded in and removed."""
+    spec = CountMinSpec(depth=4, width=1 << 10)
+    ha, ca = _stream(2000, seed=21)
+    hb, cb = _stream(3000, seed=22)
+    ta = cm_build(ha, ca, spec)
+    tb = cm_build(hb, cb, spec)
+    diff = cm_sub(cm_merge(ta, tb), tb)
+    assert np.array_equal(diff, ta)
+    # The streaming accumulate agrees: add both, subtract one.
+    acc = np.zeros((spec.depth, spec.width), np.int64)
+    cm_add(acc, ha, ca, spec)
+    cm_add(acc, hb, cb, spec)
+    assert np.array_equal(cm_sub(acc, tb), ta)
+    # One-sided error preserved: queries on the subtracted table still
+    # bound A's true per-key totals from above.
+    uniq, inv = np.unique(ha, return_inverse=True)
+    true = np.zeros(len(uniq), np.int64)
+    np.add.at(true, inv, ca)
+    est = cm_query(diff, uniq, spec).astype(np.int64)
+    assert np.all(est >= true)
+
+
+def test_cm_topk_delta_never_false_regresses_above_bound():
+    """The sentinel's verdict gate as a sketch property: rank keys by
+    their ESTIMATED baseline-to-current delta (two independently built
+    tables), compare against the exact concatenated-stream oracle —
+    no key, top-K or otherwise, may claim a regression exceeding its
+    true delta by more than the propagated two-sided bound
+    eps * (total_base + total_cur)."""
+    spec = CountMinSpec(depth=4, width=1 << 12)
+    rng = np.random.default_rng(31)
+    n_keys = 2000
+    keys = rng.integers(0, 1 << 32, n_keys, dtype=np.uint64).astype(
+        np.uint32)
+    base_counts = (rng.zipf(1.4, n_keys) % 500 + 1).astype(np.int64)
+    cur_counts = base_counts.copy()
+    # A genuine 2x regression on 20 HOT keys (a 2x of a count-1 key is
+    # indistinguishable from noise by design — that is what the
+    # sentinel's floors exist for), noise elsewhere.
+    hot = rng.permutation(np.argsort(base_counts)[-50:])[:20]
+    cur_counts[hot] *= 2
+    cur_counts += rng.poisson(3, n_keys)
+    t_base = cm_build(keys, base_counts, spec)
+    t_cur = cm_build(keys, cur_counts, spec)
+    claimed = (cm_query(t_cur, keys, spec).astype(np.int64)
+               - cm_query(t_base, keys, spec).astype(np.int64))
+    true_delta = cur_counts - base_counts
+    bound = spec.epsilon * (base_counts.sum() + cur_counts.sum())
+    # No false regression above the propagated bound — anywhere, so in
+    # particular not among the top-K claimed deltas the sentinel ranks.
+    overshoot = claimed - true_delta
+    assert int((overshoot > bound).sum()) == 0
+    # And the top-claimed set actually finds the injected regressions.
+    top = np.argsort(claimed)[-20:]
+    assert len(set(top.tolist()) & set(hot.tolist())) >= 15
 
 
 @pytest.mark.parametrize("true_card", [100, 10_000, 200_000])
