@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale bench-regress trace-smoke hotspot-smoke regress-smoke fixtures golden clean install
+.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale bench-feed bench-regress trace-smoke hotspot-smoke regress-smoke fixtures golden clean install
 
 all: native
 
@@ -38,7 +38,7 @@ test-live:
 # coverage honest (every SITES entry exercised here, and vice versa),
 # so drift fails fast before any test runs.
 chaos: lint
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py tests/test_feed_coalesce.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the
@@ -95,6 +95,15 @@ bench-sinks:
 # overrides the tier list for quick runs.
 bench-scale:
 	JAX_PLATFORMS=cpu PARCA_BENCH_SCALE_CHILD=1 $(PYTHON) bench.py
+
+# Ingest-wall A/B (docs/perf.md "ingest wall"): the scale sweep's pid
+# tiers fed through raw / coalesced / coalesced+native-hash arms —
+# per-window feed seconds reduced >= 3x at the top tier, coalesced+
+# native saturation < 50% of the window, zero windows lost, counts +
+# pprof identity held across every arm. Host-bound, so it pins the
+# cpu backend. PARCA_BENCH_FEED_TIERS overrides for quick runs.
+bench-feed:
+	JAX_PLATFORMS=cpu PARCA_BENCH_FEED_CHILD=1 $(PYTHON) bench.py
 
 # Regression sentinel acceptance drill (docs/regression.md): a
 # synthetic window stream through the REAL encode pipeline with a 2x

@@ -2282,6 +2282,11 @@ def _scale_sweep() -> dict:
             "rows": pids_n * 2,
             "noisy_mult": noisy_mult,
             "feed_ms": round(_median_ms(feeds), 2),
+            # The ingest ceiling as a first-class tracked number (docs/
+            # perf.md "ingest wall"): per-window feed seconds over the
+            # 10 s production window. 100 means the feed IS the window.
+            "feed_saturation_pct": round(
+                _median_ms(feeds) / 10_000 * 100, 1),
             "close_first_ms": round(closes[0] * 1e3, 2),
             "close_steady_ms": round(_median_ms(closes[1:]), 2),
             "admission_account_ms": round(_median_ms(account_s), 2),
@@ -2294,6 +2299,8 @@ def _scale_sweep() -> dict:
                   f"{tier['close_steady_ms']}ms, rss {tier['rss_mb']}MB")
     phase["windows_lost"] = windows_lost
     phase["innocent_tenants_degraded"] = innocent_degraded
+    phase["feed_saturation_pct"] = max(
+        t["feed_saturation_pct"] for t in phase["tiers"])
     phase["admission"] = {k: v for k, v in adm.stats.items()
                           if isinstance(v, int)}
     by_pids = {t["pids"]: t for t in phase["tiers"]}
@@ -2312,6 +2319,157 @@ def _scale_sweep() -> dict:
     elif ratio > 2.0:
         phase["error"] = (f"steady close at {mid} pids is {ratio:.2f}x "
                           f"the {lo}-pid tier (bar: 2x)")
+    return phase
+
+
+def _feed_wall() -> dict:
+    """`make bench-feed`: the ingest-wall A/B (docs/perf.md "ingest
+    wall"). PR 13's scale_sweep measured per-window feed work growing
+    O(rows) — 1.1 s -> 11.3 s from 50k to 500k pids — which saturates
+    the 10 s window and caps the pid axis. This phase runs the sweep's
+    pid tiers through three arms of the SAME window stream:
+
+      raw                coalesce off, numpy lane-matrix hash (the
+                         PR 13 baseline feed path, re-measured)
+      coalesced          the (stack, weight) fold, numpy hash
+      coalesced+native   the fold + the C batch row-hash kernel
+
+    Each tier's window carries cross-thread stack repetition (every
+    unique (pid, stack) appears on PARCA_BENCH_FEED_DUP tids — the
+    shape a multi-threaded service hands the drain), so the fold has
+    real duplicates to collapse. Bars (the error field, scored via
+    _finalize_result): per-window feed seconds at the top tier reduced
+    >= 3x vs the raw arm, feed_saturation_pct < 50 for the coalesced+
+    native arm, zero windows lost, and identity held across all arms —
+    counts byte-equal at every tier, pprof sha256 at the lowest tier
+    (encoding 500k pids of statics would measure the statics wall, not
+    the feed)."""
+    import hashlib as _hl
+
+    from parca_agent_tpu.aggregator.dict import DictAggregator
+    from parca_agent_tpu.capture.formats import STACK_SLOTS, MappingTable, \
+        WindowSnapshot
+    from parca_agent_tpu.pprof.window_encoder import WindowEncoder
+
+    tiers = [int(x) for x in os.environ.get(
+        "PARCA_BENCH_FEED_TIERS", "50000,200000,500000").split(",")]
+    windows = max(2, int(os.environ.get("PARCA_BENCH_FEED_WINDOWS", 3)))
+    dup = max(2, int(os.environ.get("PARCA_BENCH_FEED_DUP", 2)))
+    pprof_tier = min(tiers)
+
+    def _tier_snapshot(pids_n: int) -> WindowSnapshot:
+        # One unique stack per pid, repeated on `dup` tids: at dup=2 the
+        # top tier carries the PR 13 baseline's row count (1M rows at
+        # 500k pids) with the cross-thread repetition real workloads
+        # have — uniques = rows / dup is what the fold collapses to.
+        n_u = pids_n
+        pids_u = np.arange(1, n_u + 1, dtype=np.int64)
+        stacks_u = np.zeros((n_u, STACK_SLOTS), np.uint64)
+        row = np.arange(n_u, dtype=np.uint64)
+        stacks_u[:, 0] = 0x10000 + row * 0x40
+        stacks_u[:, 1] = 0x900000 + (row % 4096) * 0x10
+        idx = np.repeat(np.arange(n_u), dup)
+        n = len(idx)
+        return WindowSnapshot(
+            pids=pids_u[idx], tids=np.arange(1, n + 1, dtype=np.int64),
+            counts=np.ones(n, np.int64),
+            user_len=np.full(n, 2, np.int32),
+            kernel_len=np.zeros(n, np.int32),
+            stacks=stacks_u[idx], mappings=MappingTable.empty(),
+        )
+
+    arms = ("raw", "coalesced", "coalesced+native")
+
+    def _arm_env(arm):
+        if arm == "coalesced+native":
+            os.environ.pop("PARCA_NO_NATIVE_HASH", None)
+        else:
+            os.environ["PARCA_NO_NATIVE_HASH"] = "1"
+
+    phase: dict = {"tiers": [], "windows_per_tier": windows, "dup": dup,
+                   "arms": list(arms)}
+    windows_lost = 0
+    counts_identical = True
+    pprof_identical = True
+    try:
+        for pids_n in tiers:
+            snap = _tier_snapshot(pids_n)
+            want_mass = int(snap.counts.sum())
+            tier: dict = {"pids": pids_n, "rows": len(snap),
+                          "uniques": len(snap) // dup}
+            counts_sha: dict[str, list] = {}
+            pprof_sha: dict[str, list] = {}
+            n_u = len(snap) // dup
+            for arm in arms:
+                _arm_env(arm)
+                cap = 1 << max(16, (4 * n_u - 1).bit_length())
+                agg = DictAggregator(
+                    capacity=cap, id_cap=1 << (2 * n_u - 1).bit_length(),
+                    overflow="sketch", coalesce=arm != "raw")
+                enc = WindowEncoder(agg) if pids_n == pprof_tier else None
+                feeds = []
+                counts_sha[arm] = []
+                pprof_sha[arm] = []
+                for w in range(windows):
+                    agg.discard_open_window()
+                    t0 = time.perf_counter()
+                    agg.feed(snap)
+                    feeds.append(time.perf_counter() - t0)
+                    counts = agg.close_window(copy=True)
+                    if int(np.asarray(counts).sum()) != want_mass:
+                        windows_lost += 1
+                    counts_sha[arm].append(
+                        _hl.sha256(np.ascontiguousarray(
+                            counts, np.int64).tobytes()).hexdigest())
+                    if enc is not None:
+                        out = enc.encode(counts, 1_000 + w, 10**10, 10**7)
+                        h = _hl.sha256()
+                        for pid, blob in out:
+                            h.update(str(pid).encode())
+                            h.update(blob)
+                        pprof_sha[arm].append(h.hexdigest())
+                tier[arm] = {
+                    "feed_first_ms": round(feeds[0] * 1e3, 2),
+                    "feed_steady_ms": round(_median_ms(feeds[1:]), 2),
+                    "feed_saturation_pct": round(
+                        _median_ms(feeds[1:]) / 10_000 * 100, 1),
+                }
+                del agg, enc
+            if any(counts_sha[a] != counts_sha["raw"] for a in arms):
+                counts_identical = False
+            if any(pprof_sha[a] != pprof_sha["raw"] for a in arms):
+                pprof_identical = False
+            tier["feed_reduction_vs_raw"] = round(
+                tier["raw"]["feed_steady_ms"]
+                / max(tier["coalesced+native"]["feed_steady_ms"], 1e-9), 2)
+            phase["tiers"].append(tier)
+            _progress(
+                f"feed tier {pids_n} pids: raw "
+                f"{tier['raw']['feed_steady_ms']}ms -> coalesced+native "
+                f"{tier['coalesced+native']['feed_steady_ms']}ms "
+                f"({tier['feed_reduction_vs_raw']}x)")
+    finally:
+        os.environ.pop("PARCA_NO_NATIVE_HASH", None)
+    top = max(tiers)
+    by_pids = {t["pids"]: t for t in phase["tiers"]}
+    reduction = by_pids[top]["feed_reduction_vs_raw"]
+    top_sat = by_pids[top]["coalesced+native"]["feed_saturation_pct"]
+    phase["windows_lost"] = windows_lost
+    phase["feed_reduction_vs_raw"] = reduction
+    phase["feed_saturation_pct"] = top_sat
+    phase["bytes_identical"] = bool(counts_identical and pprof_identical)
+    if windows_lost:
+        phase["error"] = f"{windows_lost} windows lost mass"
+    elif not counts_identical:
+        phase["error"] = "window counts differ across feed arms"
+    elif not pprof_identical:
+        phase["error"] = "pprof bytes differ across feed arms"
+    elif reduction < 3.0:
+        phase["error"] = (f"top-tier feed reduced only {reduction}x "
+                          "vs the raw arm (bar: 3x)")
+    elif top_sat >= 50:
+        phase["error"] = (f"coalesced+native feed saturation "
+                          f"{top_sat}% at the top tier (bar: < 50)")
     return phase
 
 
@@ -2470,6 +2628,22 @@ def _scale_main() -> None:
     print(json.dumps({"metric": "scale_sweep", **phase}))
 
 
+def _feed_main() -> None:
+    """`make bench-feed`: the ingest-wall A/B alone, one JSON line.
+    Host-bound (the feed's hash/coalesce/pack work is pure host; the
+    dispatch runs on the pinned backend like the scale sweep's)."""
+    try:
+        phase = _feed_wall()
+    except Exception as e:  # noqa: BLE001 - the line must still print
+        phase = {"error": repr(e)[:300]}
+    import jax
+
+    phase["backend"] = jax.default_backend()
+    _finalize_result(phase, device_alive=True,
+                     require_full_scale=False, require_device=False)
+    print(json.dumps({"metric": "feed_wall", **phase}))
+
+
 def _regress_main() -> None:
     """`make bench-regress`: the regression sentinel drill alone, one
     JSON line. Host-bound (pipeline + sentinel are pure host work)."""
@@ -2535,6 +2709,9 @@ def main() -> None:
         return
     if os.environ.get("PARCA_BENCH_SCALE_CHILD"):
         _scale_main()
+        return
+    if os.environ.get("PARCA_BENCH_FEED_CHILD"):
+        _feed_main()
         return
     if os.environ.get("PARCA_BENCH_PROBE_CHILD"):
         _probe_main()

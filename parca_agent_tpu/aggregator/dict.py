@@ -45,8 +45,10 @@ from parca_agent_tpu.capture.formats import (
     STACK_SLOTS,
     MappingTable,
     WindowSnapshot,
+    fold_rows_first_seen,
 )
 from parca_agent_tpu.ops.hashing import row_hash_np
+from parca_agent_tpu.utils import faults
 
 # Linear-probe bound. The capacity guard keeps load factor <= 0.5, and at
 # the default table sizing (2x the id capacity) it stays <= 0.25, where
@@ -55,6 +57,11 @@ from parca_agent_tpu.ops.hashing import row_hash_np
 # the miss buffer. Chains that do exceed the bound are absorbed by the
 # host as overflow misses; exactness is unaffected either way.
 _PROBES = 16
+
+# Miss batches at or above this size take the vectorized settle path
+# (plan-then-commit over the host mirror, one registry append per batch);
+# below it the scalar loop's constant factors win and the batch is noise.
+_VEC_MISS_MIN = 512
 
 
 def make_feed(cap: int, id_cap: int, n_pad: int, n_blocks: int = 0,
@@ -381,7 +388,8 @@ class DictAggregator:
                  cm_spec: "CountMinSpec | None" = None,
                  rotate_min_age: int = 6,
                  delta_fetch: bool = True,
-                 probe_backend: str = "lax"):
+                 probe_backend: str = "lax",
+                 coalesce: bool = True):
         from parca_agent_tpu.ops.sketch import CountMinSpec, HLLSpec
 
         if capacity & (capacity - 1):
@@ -401,6 +409,18 @@ class DictAggregator:
         # lax (never upgrade mid-run: the jit cache keys on it).
         self._probe_backend = probe_backend
         self._probe_resolved: str | None = None
+        # Host-side feed coalescing (docs/perf.md "ingest wall"): dedupe
+        # each feed batch into (stack, weight) pairs on the (h1, h2, h3)
+        # identity BEFORE packing, so dispatch rows track unique stacks,
+        # not sample rows. Exact by the same 96-bit identity the whole
+        # aggregator keys on (equal triples accumulate into one id
+        # anyway; coalescing just sums their counts one boundary
+        # earlier), and first-occurrence ordered so miss order — and
+        # therefore id assignment and pprof bytes — is bit-identical to
+        # the uncoalesced stream. A coalesce failure (chaos site
+        # feed.coalesce) is counted and degrades to the uncoalesced
+        # path, never a lost feed.
+        self._coalesce = coalesce
         self._cm_spec = cm_spec or CountMinSpec()
         self._hll_spec = HLLSpec()
         self._cm = None                  # lazy [depth, width] int64
@@ -480,7 +500,9 @@ class DictAggregator:
         # handles without a host sync; the miss check settles at the NEXT
         # feed (or at close), by which time the kernel has long finished —
         # the capture thread stops paying the probe kernel's latency.
-        self._miss_inflight = None  # (handle, packed, snapshot, lo, h1..h3)
+        # (handle, packed, snapshot, lo, h1, h2, h3, rep, weights) —
+        # rep/weights are the coalesced feed's fold (None uncoalesced).
+        self._miss_inflight = None
         # Dispatched-but-uncollected close (close_dispatch/close_collect).
         self._close_handle: _CloseHandle | None = None
         # Keys at probe-chain positions >= _PROBES: device lookups can
@@ -668,15 +690,65 @@ class DictAggregator:
             # First feed of a new window: the boundary where cold-id
             # rotation is safe (nothing live indexes stack ids).
             self._maybe_rotate()
-        h1, h2, h3 = hashes if hashes is not None else self.hash_rows(snapshot)
+        if hashes is not None:
+            h1, h2, h3 = hashes
+        else:
+            t0 = _time.perf_counter()
+            h1, h2, h3 = self.hash_rows(snapshot)
+            self.timings["feed_hash"] = _time.perf_counter() - t0
+        # Coalesce the batch to (stack, weight) pairs: dispatch rows
+        # track uniques, not samples (the accumulate kernel already
+        # takes counts, so summed weights ride for free). `rep` maps
+        # each dispatched row back to a representative snapshot row for
+        # miss resolution; `weights` carries the folded mass the miss
+        # corrections must use instead of the representative's count.
+        h1c, h2c, h3c = h1[lo:hi], h2[lo:hi], h3[lo:hi]
+        counts_c = snapshot.counts[lo:hi].astype(np.uint32)
+        rep = None
+        weights = None
+        if self._coalesce and n > 1:
+            t0 = _time.perf_counter()
+            try:
+                faults.inject("feed.coalesce")
+                key = np.empty((n, 3), np.uint32)
+                key[:, 0] = h1c
+                key[:, 1] = h2c
+                key[:, 2] = h3c
+                folded = fold_rows_first_seen(
+                    key.view(np.dtype((np.void, 12))).ravel(),
+                    snapshot.counts[lo:hi])
+                if folded is not None:
+                    rep, _inv, w64 = folded
+                    h1c, h2c, h3c = h1c[rep], h2c[rep], h3c[rep]
+                    counts_c = w64.astype(np.uint32)
+                    weights = w64
+                self.stats["coalesce_rows_in"] = \
+                    self.stats.get("coalesce_rows_in", 0) + n
+                self.stats["coalesce_rows_out"] = \
+                    self.stats.get("coalesce_rows_out", 0) \
+                    + (len(rep) if rep is not None else n)
+            except Exception as e:  # noqa: BLE001 - counted fallback
+                # Fail-open to the uncoalesced path: the feed must never
+                # be lost to the optimization riding it. Locals are only
+                # rebound on success above, so the raw slices are intact.
+                rep = None
+                weights = None
+                self.stats["coalesce_fallbacks"] = \
+                    self.stats.get("coalesce_fallbacks", 0) + 1
+                from parca_agent_tpu.utils.log import get_logger
+
+                get_logger("aggregator.dict").warn(
+                    "feed coalesce failed; dispatching the uncoalesced "
+                    "batch", error=repr(e)[:200])
+            self.timings["feed_coalesce"] = _time.perf_counter() - t0
+        nd = len(h1c)
         t0 = _time.perf_counter()
         counts_c, corrections = self._prefilter_unreachable(
-            h1[lo:hi], h2[lo:hi], h3[lo:hi],
-            snapshot.counts[lo:hi].astype(np.uint32))
+            h1c, h2c, h3c, counts_c)
         # (corrections join _pending only after the device call succeeds,
         # mirroring the miss path: a failed feed must not leave partial
         # host-side mass that a recovery close would emit as a window.)
-        n_pad = 1 << max(4, (n - 1).bit_length())
+        n_pad = 1 << max(4, (nd - 1).bit_length())
         # LRU (dict order = recency order via pop/re-insert): an
         # evict-smallest policy would pin stale large buffers after a
         # burst while current small sizes churn through one slot.
@@ -686,12 +758,12 @@ class DictAggregator:
                 self._feed_bufs.pop(next(iter(self._feed_bufs)))
             packed = np.zeros((4, n_pad), np.uint32)
         else:
-            packed[:, n:] = 0  # stale tail from a previous, larger chunk
+            packed[:, nd:] = 0  # stale tail from a previous, larger chunk
         self._feed_bufs[n_pad] = packed
-        packed[0, :n] = h1[lo:hi]
-        packed[1, :n] = h2[lo:hi]
-        packed[2, :n] = h3[lo:hi]
-        packed[3, :n] = counts_c
+        packed[0, :nd] = h1c
+        packed[1, :nd] = h2c
+        packed[2, :nd] = h3c
+        packed[3, :nd] = counts_c
         self.timings["feed_pack"] = _time.perf_counter() - t0
 
         self._ensure_device()
@@ -714,7 +786,8 @@ class DictAggregator:
         # already completed and the sync is ~free — the feed's device
         # work OVERLAPS capture instead of stalling it.
         self.timings["feed_dispatch"] = _time.perf_counter() - t0
-        self._miss_inflight = (handle, packed, snapshot, lo, h1, h2, h3)
+        self._miss_inflight = (handle, packed, snapshot, lo, h1, h2, h3,
+                               rep, weights)
 
     # palint: sync-ok — THE deferred sync boundary: by the next feed (or
     # the close) the kernel has completed, so this is a completion
@@ -729,15 +802,24 @@ class DictAggregator:
         inflight, self._miss_inflight = self._miss_inflight, None
         if inflight is None:
             return
-        handle, _packed, snapshot, lo, h1, h2, h3 = inflight
+        handle, _packed, snapshot, lo, h1, h2, h3, rep, weights = inflight
         t0 = _time.perf_counter()
         miss_rel = self._settle_dispatch(handle)
         self.timings["feed_settle"] = _time.perf_counter() - t0
         if len(miss_rel):
             t0 = _time.perf_counter()
-            rows = miss_rel.astype(np.int64) + lo
+            if rep is not None:
+                # Coalesced dispatch: miss indices address the folded
+                # rows — translate to representative snapshot rows, and
+                # carry the FOLDED weights (the representative's own
+                # count would drop its duplicates' mass).
+                rows = rep[miss_rel] + lo
+                wts = weights[miss_rel]
+            else:
+                rows = miss_rel.astype(np.int64) + lo
+                wts = None
             self._pending.extend(
-                self._resolve_misses(snapshot, rows, h1, h2, h3))
+                self._resolve_misses(snapshot, rows, h1, h2, h3, wts))
             self.timings["feed_miss"] = _time.perf_counter() - t0
 
     def _new_acc(self):
@@ -1273,29 +1355,45 @@ class DictAggregator:
             table[:, 3] = np.where(self._occ, self._ids + 1, 0).astype(np.uint32)
             self._dev = jnp.asarray(table)
 
-    def _resolve_misses(self, snapshot, rows, h1, h2, h3
+    def _resolve_misses(self, snapshot, rows, h1, h2, h3, weights=None
                         ) -> list[tuple[int, int]]:
         """Absorb device-miss rows: insert genuinely new stacks (host mirror
         + device table), and return (stack_id, count) corrections the caller
-        must add to the window's counts."""
-        import jax.numpy as jnp
+        must add to the window's counts. ``weights`` overrides
+        ``snapshot.counts[rows]`` (the coalesced feed's folded masses);
+        large clean batches take the vectorized plan-then-commit path,
+        every degradation case falls back to this scalar loop."""
+        rows = np.asarray(rows, np.int64)
+        wts = (np.asarray(weights, np.int64) if weights is not None
+               else snapshot.counts[rows].astype(np.int64))
+        if len(rows) >= _VEC_MISS_MIN:
+            out = self._resolve_misses_vec(snapshot, rows, h1, h2, h3, wts)
+            if out is not None:
+                return out
+            self.stats["miss_vec_fallbacks"] = \
+                self.stats.get("miss_vec_fallbacks", 0) + 1
+        return self._resolve_misses_scalar(snapshot, rows, h1, h2, h3, wts)
 
+    def _resolve_misses_scalar(self, snapshot, rows, h1, h2, h3, wts
+                               ) -> list[tuple[int, int]]:
+        """The reference miss loop: handles every degradation case
+        (sketch absorb, rotation request, per-key placement refusal)."""
         # Classify first, mutate second: capacity is validated against the
         # ACTUAL number of new keys before anything is inserted — raising
         # mid-loop would leave keys in _key_to_id without per-id metadata
         # or device-table entries, corrupting every later window. (Device
         # misses that are merely probe-bound overflows of known keys cost
         # nothing here.)
-        classified: list[tuple[int, tuple, int | None]] = []
+        classified: list[tuple[int, int, tuple, int | None]] = []
         n_new = 0
         seen_batch: set = set()
-        for r in map(int, rows):
+        for pos, r in enumerate(map(int, rows)):
             key = (int(h1[r]), int(h2[r]), int(h3[r]))
             existing = self._key_to_id.get(key)
             if existing is None and key not in seen_batch:
                 seen_batch.add(key)
                 n_new += 1
-            classified.append((r, key, existing))
+            classified.append((pos, r, key, existing))
         worst = self._next_id + n_new
         budget = n_new
         if worst > self._id_cap or worst * 2 > self._cap:
@@ -1320,17 +1418,18 @@ class DictAggregator:
         absorb_h: list[int] = []
         absorb_c: list[int] = []
         pending: list[tuple[int, int]] = []  # (sid, count) corrections
-        for r, key, existing in classified:
+        for pos, r, key, existing in classified:
+            w = int(wts[pos])
             if existing is None:
                 existing = self._key_to_id.get(key)  # set earlier this loop?
             if existing is not None:
                 # Probe-bound overflow on device; host resolves it.
                 self.stats["overflow_misses"] += 1
-                pending.append((existing, int(snapshot.counts[r])))
+                pending.append((existing, w))
                 continue
             if budget <= 0:
                 absorb_h.append(key[0])
-                absorb_c.append(int(snapshot.counts[r]))
+                absorb_c.append(w)
                 continue
             slot = self._try_insert_slot(key)
             if slot is None:
@@ -1341,7 +1440,7 @@ class DictAggregator:
                 # _check_insert_room validated pre-mutation.
                 self._rotate_pending = True
                 absorb_h.append(key[0])
-                absorb_c.append(int(snapshot.counts[r]))
+                absorb_c.append(w)
                 continue
             budget -= 1
             sid = self._next_id
@@ -1354,7 +1453,7 @@ class DictAggregator:
             self._last_seen[sid] = self.stats["windows"] + 1
             new_slots.append(slot)
             new_rows.append(r)
-            pending.append((sid, int(snapshot.counts[r])))
+            pending.append((sid, w))
             self.stats["inserts"] += 1
 
         if absorb_h:
@@ -1367,13 +1466,7 @@ class DictAggregator:
             # so concurrent readers pacing by the watermark never see an
             # id without its hashes.
             base = self._next_id - len(new_slots)
-            if self._next_id > len(self._id_h1):
-                for name in ("_id_h1", "_id_h2"):
-                    old = getattr(self, name)
-                    grown = np.empty(max(self._next_id, 2 * len(old)),
-                                     np.uint32)
-                    grown[:base] = old[:base]
-                    setattr(self, name, grown)
+            self._grow_id_hashes(base)
             self._id_h1[base:self._next_id] = self._h1[new_slots]
             self._id_h2[base:self._next_id] = self._h2[new_slots]
             self._register_stacks_bulk(snapshot, np.array(new_rows, np.int64))
@@ -1384,6 +1477,204 @@ class DictAggregator:
             vals[:, 2] = self._h3[new_slots]
             vals[:, 3] = (self._ids[new_slots] + 1).astype(np.uint32)
             self._dev_scatter(slots, vals)
+        return pending
+
+    def _grow_id_hashes(self, keep: int) -> None:
+        """Grow the per-id hash mirrors to hold [0, _next_id), copying
+        the first `keep` published lanes (both settle paths' commit
+        tails share this so the growth policy cannot drift)."""
+        if self._next_id <= len(self._id_h1):
+            return
+        for name in ("_id_h1", "_id_h2"):
+            old = getattr(self, name)
+            grown = np.empty(max(self._next_id, 2 * len(old)), np.uint32)
+            grown[:keep] = old[:keep]
+            setattr(self, name, grown)
+
+    # -- vectorized miss settle (docs/perf.md "ingest wall") ------------------
+    #
+    # The first window of a cold tier (and every churn burst) resolves
+    # 100k+ misses; the scalar loop above pays per-row Python — tuple
+    # construction, dict probes, per-element numpy reads — which dwarfs
+    # the device work it follows. The vectorized twin PLANS with pure
+    # array reads (classification probe + first-empty-slot arbitration
+    # over the host mirror), then COMMITS the whole batch as one
+    # vectorized registry append. Any degradation case (capacity
+    # shortfall, unplaceable keys, arbitration overrun) falls back to
+    # the scalar loop BEFORE any mutation, so the degrade ladder stays
+    # single-sourced.
+
+    def _probe_geometry_vec(self, h1u, h2u):
+        """(base, start, mask) per key for the vectorized host-mirror
+        probe: slot(k) = base + ((start + k) & mask). The base table
+        probes the whole table from h1 & mask."""
+        mask = self._cap - 1
+        return (np.zeros(len(h1u), np.int64),
+                h1u.astype(np.int64) & mask, mask)
+
+    def _check_insert_room_vec(self, h1n, h2n, h3n) -> None:
+        """Vectorized twin of _check_insert_room (pre-mutation, may
+        raise). No-op here: the global capacity gate already ran."""
+
+    def _classify_keys_vec(self, h1u, h2u, h3u):
+        """Probe every unique key against the host mirror in lockstep:
+        returns (ids, stop, overrun) — ids[j] >= 0 for a known key,
+        stop[j] = first empty slot on a new key's chain, overrun True
+        when any chain wrapped a full (sub-)table (caller falls back)."""
+        base, start, mask = self._probe_geometry_vec(h1u, h2u)
+        m = len(h1u)
+        ids = np.full(m, -1, np.int64)
+        stop = np.full(m, -1, np.int64)
+        alive = np.arange(m, dtype=np.int64)
+        k = 0
+        while len(alive):
+            if k > mask:
+                return ids, stop, True
+            idx = base[alive] + ((start[alive] + k) & mask)
+            occ = self._occ[idx]
+            empty = np.flatnonzero(~occ)
+            stop[alive[empty]] = idx[empty]
+            hit = occ & (self._h1[idx] == h1u[alive]) \
+                & (self._h2[idx] == h2u[alive]) \
+                & (self._h3[idx] == h3u[alive])
+            hsel = np.flatnonzero(hit)
+            ids[alive[hsel]] = self._ids[idx[hsel]]
+            alive = alive[occ & ~hit]
+            k += 1
+        return ids, stop, False
+
+    def _place_new_keys_vec(self, h1n, h2n, stop):
+        """First-empty-slot arbitration for a batch of new keys: every
+        key starts at its chain's first pre-batch empty slot; contested
+        slots go to the lowest batch rank (deterministic — the same
+        min-lane arbitration idiom as the Pallas loc-table builder) and
+        losers walk forward past slots occupied pre-batch or claimed
+        this batch. The result is a valid linear-probe layout (a key
+        only ever stops where its whole chain prefix is occupied), so
+        lookups — device and host — find every key or report it
+        unreachable exactly as a sequential insert order would. Returns
+        slots, or None on overrun (caller falls back to scalar)."""
+        base, start, mask = self._probe_geometry_vec(h1n, h2n)
+        n = len(h1n)
+        slots = stop.copy()
+        off = (slots - base - start) & mask
+        overlay = np.zeros(self._cap, bool)  # slots claimed this batch
+        unplaced = np.arange(n, dtype=np.int64)
+        rounds = 0
+        while len(unplaced):
+            rounds += 1
+            if rounds > 64 + 4 * _PROBES:
+                return None
+            s = slots[unplaced]
+            order = np.lexsort((unplaced, s))
+            ss = s[order]
+            firsts = np.ones(len(order), bool)
+            firsts[1:] = ss[1:] != ss[:-1]
+            win = unplaced[order[firsts]]
+            overlay[slots[win]] = True
+            unplaced = unplaced[order[~firsts]]
+            active = unplaced
+            while len(active):
+                off[active] += 1
+                if int(off[active].max(initial=0)) > mask:
+                    return None
+                nxt = base[active] + ((start[active] + off[active]) & mask)
+                slots[active] = nxt
+                blocked = self._occ[nxt] | overlay[nxt]
+                active = active[blocked]
+        return slots
+
+    def _resolve_misses_vec(self, snapshot, rows, h1, h2, h3, wts):
+        """Plan-then-commit vectorized twin of the scalar miss loop.
+        Returns the pending corrections, or None to fall back (nothing
+        mutated). Id assignment stays in first-occurrence row order, so
+        output bytes are identical to the scalar path's."""
+        h1m = np.ascontiguousarray(h1[rows], np.uint32)
+        h2m = np.ascontiguousarray(h2[rows], np.uint32)
+        h3m = np.ascontiguousarray(h3[rows], np.uint32)
+        key = np.empty((len(rows), 3), np.uint32)
+        key[:, 0] = h1m
+        key[:, 1] = h2m
+        key[:, 2] = h3m
+        folded = fold_rows_first_seen(
+            key.view(np.dtype((np.void, 12))).ravel(), wts)
+        if folded is None:
+            urep = np.arange(len(rows), dtype=np.int64)
+            uw = wts
+            row_mult = None  # every unique key came from exactly one row
+        else:
+            urep, inv, uw = folded
+            row_mult = np.bincount(inv, minlength=len(urep))
+        h1u, h2u, h3u = h1m[urep], h2m[urep], h3m[urep]
+        ids, stop, overrun = self._classify_keys_vec(h1u, h2u, h3u)
+        if overrun:
+            return None
+        new = np.flatnonzero(ids < 0)
+        n_new = len(new)
+        pending: list[tuple[int, int]] = []
+        if n_new:
+            worst = self._next_id + n_new
+            if worst > self._id_cap or worst * 2 > self._cap:
+                return None  # degradation: the scalar path owns it
+            h1n, h2n, h3n = h1u[new], h2u[new], h3u[new]
+            # Subclass pre-mutation room validation (raise-mode sharded).
+            self._check_insert_room_vec(h1n, h2n, h3n)
+            slots = self._place_new_keys_vec(h1n, h2n, stop[new])
+            if slots is None:
+                return None
+            # -- commit (mirrors the scalar tail, batch-at-once) --------
+            base_sid = self._next_id
+            sids = np.arange(base_sid, base_sid + n_new, dtype=np.int64)
+            self._next_id = base_sid + n_new
+            keys = list(zip(h1n.tolist(), h2n.tolist(), h3n.tolist()))
+            self._key_to_id.update(zip(keys, sids.tolist()))
+            self._occ[slots] = True
+            self._h1[slots] = h1n
+            self._h2[slots] = h2n
+            self._h3[slots] = h3n
+            self._ids[slots] = sids
+            gbase, gstart, gmask = self._probe_geometry_vec(h1n, h2n)
+            dist = (slots - gbase - gstart) & gmask
+            for j in np.flatnonzero(dist >= _PROBES):
+                self._unreachable[keys[int(j)]] = int(sids[j])
+                self._unreach_h1 = None
+            self._last_seen[sids] = self.stats["windows"] + 1
+            self.stats["inserts"] += n_new
+            self.stats["miss_vec_inserts"] = \
+                self.stats.get("miss_vec_inserts", 0) + n_new
+            # Per-id hash lanes land BEFORE _register_stacks_bulk
+            # publishes the batch (same ordering contract as the scalar
+            # path: readers pacing by _published never see an id
+            # without its hashes).
+            self._grow_id_hashes(base_sid)
+            self._id_h1[base_sid:self._next_id] = h1n
+            self._id_h2[base_sid:self._next_id] = h2n
+            self._register_stacks_bulk(snapshot, rows[urep[new]])
+            vals = np.zeros((n_new, 4), np.uint32)
+            vals[:, 0] = h1n
+            vals[:, 1] = h2n
+            vals[:, 2] = h3n
+            vals[:, 3] = (sids + 1).astype(np.uint32)
+            self._dev_scatter(slots, vals)
+            pending.extend(zip(sids.tolist(), uw[new].tolist()))
+            if row_mult is not None:
+                # The scalar loop counts every duplicate row of a key
+                # inserted earlier in the same batch as an overflow
+                # miss (it resolves via the just-updated _key_to_id);
+                # the fold collapsed those rows — count them back so
+                # the stat keeps one unit across both paths.
+                self.stats["overflow_misses"] += \
+                    int((row_mult[new] - 1).sum())
+        exist = np.flatnonzero(ids >= 0)
+        if len(exist):
+            # Counted per MISS ROW (folded multiplicity), matching the
+            # scalar loop's meaning exactly — the stat must not change
+            # units with the batch size that picked the path.
+            self.stats["overflow_misses"] += (
+                int(row_mult[exist].sum()) if row_mult is not None
+                else len(exist))
+            pending.extend(zip(ids[exist].tolist(),
+                               uw[exist].astype(np.int64).tolist()))
         return pending
 
     def _dev_scatter(self, slots: np.ndarray, vals: np.ndarray) -> None:

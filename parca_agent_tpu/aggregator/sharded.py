@@ -188,7 +188,10 @@ class ShardedDictAggregator(DictAggregator):
         # re-route would mint a second key for the same stack (harmless
         # mass-wise, wasteful registry-wise; rotation reclaims it).
         self._shard_of_pid = shard_of_pid
-        self._part_bufs: dict[int, np.ndarray] = {}  # n_pad_s -> buffer
+        # n_pad_s -> [buf_a, buf_b, flip]: double-buffered pack scratch
+        # (pack N+1 must not overwrite the buffer dispatch N may still
+        # be reading through an async H2D).
+        self._part_bufs: dict[int, list] = {}
         super().__init__(capacity=capacity, id_cap=id_cap, **kw)
         # Delta-fetch touch tracking is single-chip for now: the sharded
         # close psums partial accumulators across the mesh and fetches
@@ -226,12 +229,9 @@ class ShardedDictAggregator(DictAggregator):
         occ = self._occ.reshape(self._n_shards, self._cap_s)
         return self._cap_s - occ.sum(axis=1)
 
-    def _check_insert_room(self, classified, seen_batch) -> None:
-        if self._overflow != "raise" or not seen_batch:
-            return  # sketch mode degrades per key in _try_insert_slot
-        demand = np.zeros(self._n_shards, np.int64)
-        for key in seen_batch:
-            demand[self._home_shard(key)] += 1
+    def _check_shard_demand(self, demand: np.ndarray) -> None:
+        """Shared raise tail of both insert-room checks (scalar and
+        vectorized): per-sub-table new-key demand vs free slots."""
         free = self._shard_free()
         over = np.flatnonzero(demand > free)
         if len(over):
@@ -240,6 +240,14 @@ class ShardedDictAggregator(DictAggregator):
                 f"shard sub-table {s} exhausted ({int(demand[s])} new keys "
                 f"vs {int(free[s])} free of {self._cap_s} slots); construct "
                 f"with a larger capacity or overflow='sketch'")
+
+    def _check_insert_room(self, classified, seen_batch) -> None:
+        if self._overflow != "raise" or not seen_batch:
+            return  # sketch mode degrades per key in _try_insert_slot
+        demand = np.zeros(self._n_shards, np.int64)
+        for key in seen_batch:
+            demand[self._home_shard(key)] += 1
+        self._check_shard_demand(demand)
 
     def _try_insert_slot(self, key: tuple) -> int | None:
         base = self._home_shard(key) * self._cap_s
@@ -264,6 +272,24 @@ class ShardedDictAggregator(DictAggregator):
         mask = self._cap_s - 1
         within = slot - self._home_shard(key) * self._cap_s
         return (within - (key[0] & mask)) & mask
+
+    def _probe_geometry_vec(self, h1u, h2u):
+        # The vectorized settle's probe geometry: chains live entirely
+        # within the key's home sub-table (base = home * cap_s), exactly
+        # as _try_insert_slot/_chain_dist walk them per key.
+        mask = self._cap_s - 1
+        base = (h2u.astype(np.int64) % self._n_shards) * self._cap_s
+        return base, h1u.astype(np.int64) & mask, mask
+
+    def _check_insert_room_vec(self, h1n, h2n, h3n) -> None:
+        # Vectorized twin of _check_insert_room: pre-mutation,
+        # raise-mode only (sketch mode degrades per key via the
+        # placement overrun fallback); the raise tail is shared.
+        if self._overflow != "raise" or not len(h2n):
+            return
+        self._check_shard_demand(
+            np.bincount(h2n.astype(np.int64) % self._n_shards,
+                        minlength=self._n_shards))
 
     # -- device dispatch ------------------------------------------------------
 
@@ -296,7 +322,15 @@ class ShardedDictAggregator(DictAggregator):
     def _partition_packed(self, packed: np.ndarray) -> np.ndarray:
         """Split the [4, n_pad] packed buffer into [n_shards, 5, n_pad_s]
         by home shard (h2 % n_shards), appending each row's original
-        position as channel 4. Pad lanes are zero (count 0 = dead)."""
+        position as channel 4. Pad lanes are zero (count 0 = dead).
+
+        One vectorized scatter per channel (the per-shard Python slice
+        loop this replaces walked the shard axis serially — at 8+ shards
+        the loop overhead was a visible slice of the per-drain host
+        cost), into a DOUBLE-BUFFERED scratch: the previous drain's
+        partition buffer stays untouched while this one packs, so
+        pack(N+1) can overlap dispatch(N)'s H2D reads even on backends
+        that consume host memory asynchronously."""
         cnt = packed[3]
         live = np.flatnonzero(cnt > 0)
         shard = (packed[1, live] % np.uint32(self._n_shards)).astype(np.int64)
@@ -316,31 +350,68 @@ class ShardedDictAggregator(DictAggregator):
         else:
             step = 1 << max(2, n_max.bit_length() - 3)
             n_pad_s = -(-n_max // step) * step
-        # Reuse one buffer per lane count (same rationale as the base
-        # feed's _feed_bufs: a fresh multi-MB zeroed allocation per drain
-        # is pure churn on the host hot path); quarter-pow2 lane sizing
-        # bounds the distinct shapes to ~4 per octave of drain size.
-        # LRU, not evict-smallest: quarter-pow2 sizing yields ~4 shapes
-        # per octave (vs pow2's 1), so a size-ordered policy both
-        # thrashes when drains jitter across an octave boundary and pins
-        # large stale buffers forever after a burst. 8 recently-used
+        # Reuse TWO buffers per lane count, alternating (same rationale
+        # as the base feed's _feed_bufs — fresh multi-MB zeroed
+        # allocations per drain are pure churn — plus the double-buffer
+        # contract above); quarter-pow2 lane sizing bounds the distinct
+        # shapes to ~4 per octave of drain size. LRU, not
+        # evict-smallest: quarter-pow2 sizing yields ~4 shapes per
+        # octave (vs pow2's 1), so a size-ordered policy both thrashes
+        # when drains jitter across an octave boundary and pins large
+        # stale buffers forever after a burst. 8 recently-used shape
         # slots track the actual working set; re-insertion on hit keeps
         # dict order = recency order.
-        out = self._part_bufs.pop(n_pad_s, None)
-        if out is None:
+        pair = self._part_bufs.pop(n_pad_s, None)
+        if pair is None:
             if len(self._part_bufs) >= 8:
                 self._part_bufs.pop(next(iter(self._part_bufs)))  # LRU
-            out = np.zeros((self._n_shards, 5, n_pad_s), np.uint32)
+            pair = [None, None, 0]
+        flip = pair[2]
+        pair[2] = flip ^ 1
+        out = pair[flip]
+        if out is None:
+            out = pair[flip] = np.zeros((self._n_shards, 5, n_pad_s),
+                                        np.uint32)
         else:
             out[:] = 0
-        self._part_bufs[n_pad_s] = out
+        self._part_bufs[n_pad_s] = pair
         bounds = np.zeros(self._n_shards + 1, np.int64)
         np.cumsum(per, out=bounds[1:])
-        for s in range(self._n_shards):
-            mine = rows[bounds[s]: bounds[s + 1]]
-            out[s, :4, : len(mine)] = packed[:, mine]
-            out[s, 4, : len(mine)] = mine.astype(np.uint32)
+        shard_sorted = shard[order]
+        lane = np.arange(len(rows), dtype=np.int64) - bounds[shard_sorted]
+        for c in range(4):
+            out[shard_sorted, c, lane] = packed[c, rows]
+        out[shard_sorted, 4, lane] = rows.astype(np.uint32)
         return out
+
+    def _device_put_sharded(self, part: np.ndarray):
+        """Ship the partitioned batch: one per-shard device_put per mesh
+        device, assembled into the global sharded array — the transfers
+        are dispatched back-to-back WITHOUT waiting on each other, so
+        the sub-batches travel concurrently instead of through one
+        serially-staged global copy. Counted fallback to the single
+        staged device_put on any runtime refusal (layouts, committed
+        device sets) — never a lost feed."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        sharding = NamedSharding(self._mesh, P(FLEET_AXIS, None, None))
+        try:
+            devs = list(self._mesh.devices.reshape(-1))
+            shards = [jax.device_put(part[s:s + 1], d)
+                      for s, d in enumerate(devs)]
+            return jax.make_array_from_single_device_arrays(
+                part.shape, sharding, shards)
+        except Exception as e:  # noqa: BLE001 - counted fallback
+            self.stats["shard_put_fallbacks"] = \
+                self.stats.get("shard_put_fallbacks", 0) + 1
+            from parca_agent_tpu.utils.log import get_logger
+
+            get_logger("aggregator.sharded").warn(
+                "per-shard concurrent device_put failed; using the "
+                "staged global copy", error=repr(e)[:200])
+            return jax.device_put(part, sharding)
 
     # palint: capture-path — the sharded override of the dispatch-only
     # feed (the base seed's call graph stops at file scope, so the
@@ -348,15 +419,10 @@ class ShardedDictAggregator(DictAggregator):
     # palint: device-state: _dev, _acc, _touch, _acc_spare, _touch_spare
     def _feed_dispatch_async(self, packed: np.ndarray, n_pad: int,
                              reset: int):
-        import jax
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
-
         part = self._partition_packed(packed)
         prog = _sharded_feed_program(self._mesh, self._n_shards, self._cap_s,
                                      self._id_cap, part.shape[2])
-        dev_packed = jax.device_put(
-            part, NamedSharding(self._mesh, P(FLEET_AXIS, None, None)))
+        dev_packed = self._device_put_sharded(part)
         acc = self._acc
         self._acc = None  # donated: invalid if the call throws
         acc, n_miss, miss_rows = prog(self._dev, acc, dev_packed,

@@ -44,6 +44,7 @@ from parca_agent_tpu.capture.formats import (
     STACK_SLOTS,
     MappingTable,
     WindowSnapshot,
+    fold_rows_first_seen,
 )
 from parca_agent_tpu.ops.hashing import fold_u64_rows, multilinear_hash_u32
 
@@ -344,6 +345,39 @@ def shadow_compare(device_profiles, cpu_profiles) -> bool:
     return digest(device_profiles) == digest(cpu_profiles)
 
 
+def _coalesce_snapshot_rows(snapshot: WindowSnapshot) -> WindowSnapshot:
+    """Fold rows that are EXACT duplicates in everything the kernel
+    consumes — (pid, user_len, kernel_len, full padded stack row) — into
+    one row with summed counts, in first-occurrence order (capture/
+    formats.py fold_rows_first_seen; docs/perf.md "ingest wall").
+    Cross-tid repetition is the common source: a 100-thread service
+    hands the drain one row per (pid, tid, stack) but the kernel keys
+    on (pid, stack), so the fold shrinks the padded upload and every
+    sort lane behind it. Identity-preserving by construction — the
+    kernel's own dedup would have merged exactly these rows (full-row
+    compare), summing the same counts; tids are not packed at all."""
+    n = len(snapshot)
+    if n < 2:
+        return snapshot
+    rec = np.empty((n, STACK_SLOTS + 1), np.uint64)
+    # pid fits 32 bits, user/kernel lens fit 8 each: one header word.
+    rec[:, 0] = (snapshot.pids.astype(np.uint64) << np.uint64(32)) \
+        | (snapshot.user_len.astype(np.uint64) << np.uint64(8)) \
+        | snapshot.kernel_len.astype(np.uint64)
+    rec[:, 1:] = snapshot.stacks
+    folded = fold_rows_first_seen(
+        np.ascontiguousarray(rec).view(
+            np.dtype((np.void, (STACK_SLOTS + 1) * 8))).ravel(),
+        snapshot.counts)
+    if folded is None:
+        return snapshot
+    rep, _inv, weights = folded
+    return dataclasses.replace(
+        snapshot, pids=snapshot.pids[rep], tids=snapshot.tids[rep],
+        counts=weights, user_len=snapshot.user_len[rep],
+        kernel_len=snapshot.kernel_len[rep], stacks=snapshot.stacks[rep])
+
+
 def pack_window_inputs(snapshot: WindowSnapshot, l_cap: int | None = None):
     """Pad a WindowSnapshot into the kernel's uint32 operand layout.
 
@@ -481,6 +515,7 @@ class TPUAggregator:
         n = len(snapshot)
         if n == 0:
             return []
+        snapshot = _coalesce_snapshot_rows(snapshot)
         table = snapshot.mappings
         host_args, dims = pack_window_inputs(snapshot)
         dev_args = tuple(jnp.asarray(a) for a in host_args)

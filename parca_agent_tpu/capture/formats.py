@@ -184,6 +184,43 @@ class WindowSnapshot:
         return int(self.counts.sum())
 
 
+def fold_rows_first_seen(keys: np.ndarray, counts):
+    """Fold duplicate key rows into (unique key, summed weight) pairs in
+    FIRST-OCCURRENCE order — the host twin of the reference's in-kernel
+    ``(pid, stack) -> count`` fold (bpf/cpu/cpu.bpf.c:110-116): samples
+    are reduced to unique work BEFORE they cross an expensive boundary
+    (there the kernel->user copy, here the host->device feed dispatch
+    and the one-shot kernel's padded upload).
+
+    ``keys`` is a 1-D array whose elements compare by content (callers
+    build an ``np.void`` byte view over their key columns). Returns
+    ``None`` when every row is already unique (the common one-shot case
+    — callers skip the rebuild entirely), else ``(rep, inverse,
+    weights)``: ``rep[j]`` is the first input row carrying unique key j,
+    ``inverse[i]`` maps input row i to its unique slot, and
+    ``weights[j]`` is the exact int64 sum of its rows' counts. First-
+    occurrence ordering is what keeps downstream id assignment (miss
+    order = insertion order) bit-identical to the unfolded stream."""
+    uniq, first, inverse = np.unique(keys, return_index=True,
+                                     return_inverse=True)
+    if len(uniq) == len(keys):
+        return None
+    order = np.argsort(first, kind="stable")
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order), dtype=np.int64)
+    inv = rank[inverse.reshape(-1)]
+    counts = np.asarray(counts, np.int64)
+    if int(counts.sum()) < 2**53:
+        # float64 bincount is exact below 2^53 total mass (the same
+        # guard columns_to_snapshot's weighted dedup uses).
+        weights = np.bincount(inv, weights=counts,
+                              minlength=len(order)).astype(np.int64)
+    else:
+        weights = np.zeros(len(order), np.int64)
+        np.add.at(weights, inv, counts)
+    return first[order].astype(np.int64), inv, weights
+
+
 def filter_snapshot_rows(snap: WindowSnapshot,
                          mask: np.ndarray) -> WindowSnapshot:
     """Snapshot restricted to the rows where mask is True (columns are

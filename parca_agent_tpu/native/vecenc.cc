@@ -69,6 +69,64 @@ int64_t pa_put_varints_padded(uint8_t* out, int64_t out_len,
   return -1;
 }
 
+// Batched multilinear row hash for the dict aggregator's feed path
+// (ops/hashing.py row_hash_np). The numpy twin materializes the full
+// [N, 2*slots+3] uint32 lane matrix (hi | lo | pid | ulen | klen) and
+// multiply-sums it — ~1 GB of transient traffic per 1M-row window at
+// 128 slots, almost all of it zero padding. One native pass walks only
+// each row's LIVE prefix (depth[i] = user_len + kernel_len; the
+// WindowSnapshot contract zero-pads past it, and a zero lane
+// contributes coef*0 == 0 to a multilinear hash), so per-row work is
+// proportional to stack depth, not the 128-slot pad. All arithmetic is
+// uint32 with natural wraparound — bit-identical to the numpy path's
+// uint32 multiply/sum/mix for any contract-valid (zero-padded) row.
+//
+// Layout contract (validated by the Python wrapper): coefs is row-major
+// [n_fam, coef_stride] with coef_stride >= 2*slots + 3; family f hashes
+// hi-lane s with coefs[f*stride + s], lo-lane s with
+// coefs[f*stride + slots + s], then pid/ulen/klen at 2*slots + {0,1,2}.
+// out is row-major [n_fam, n]. n_fam is capped at 4 (the hash-family
+// count baked into ops/hashing.py) — checked here because writing
+// through a caller-undersized acc would corrupt the stack.
+int64_t pa_row_hash(const uint64_t* stacks, int64_t n, int64_t slots,
+                    const uint32_t* pids, const uint32_t* ulen,
+                    const uint32_t* klen, const int32_t* depth,
+                    const uint32_t* coefs, int64_t coef_stride,
+                    const uint32_t* biases, int64_t n_fam, uint32_t* out) {
+  if (n_fam < 1 || n_fam > 4 || coef_stride < 2 * slots + 3) return 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t acc[4] = {0, 0, 0, 0};
+    const uint64_t* row = stacks + i * slots;
+    int64_t d = depth[i];
+    if (d < 0) d = 0;
+    if (d > slots) d = slots;
+    for (int64_t s = 0; s < d; s++) {
+      uint64_t v = row[s];
+      if (!v) continue;  // zero lane: coef*0 contributes nothing
+      uint32_t hi = static_cast<uint32_t>(v >> 32);
+      uint32_t lo = static_cast<uint32_t>(v);
+      for (int64_t f = 0; f < n_fam; f++) {
+        const uint32_t* c = coefs + f * coef_stride;
+        acc[f] += c[s] * hi + c[slots + s] * lo;
+      }
+    }
+    for (int64_t f = 0; f < n_fam; f++) {
+      const uint32_t* c = coefs + f * coef_stride;
+      uint32_t x = acc[f] + c[2 * slots] * pids[i] +
+                   c[2 * slots + 1] * ulen[i] + c[2 * slots + 2] * klen[i] +
+                   biases[f];
+      // mix32 finalizer (ops/hashing.py mix32, seed 0).
+      x ^= x >> 16;
+      x *= 0x85EBCA6Bu;
+      x ^= x >> 13;
+      x *= 0xC2B2AE35u;
+      x ^= x >> 16;
+      out[f * n + i] = x;
+    }
+  }
+  return -1;
+}
+
 // Ragged byte-run copy for vec.ragged_gather: run i is
 // src[src_pos[i], src_pos[i]+lens[i]) -> dst[dst_pos[i], ...). The numpy
 // fallback pays per-ELEMENT fancy indexing (repeat + arange + gather —

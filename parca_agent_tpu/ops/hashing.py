@@ -17,6 +17,9 @@ hash alone — the dedup pipeline compares full rows before merging.
 
 from __future__ import annotations
 
+import ctypes
+import os
+
 import numpy as np
 
 # Enough coefficient lanes for [hi | lo | pid | user_len | kernel_len].
@@ -94,10 +97,95 @@ def fold_u64_rows(hi, lo, extra=None):
     return xp.concatenate(cols, axis=-1)
 
 
+# Native batch row-hash kernel (native/vecenc.cc pa_row_hash): the numpy
+# path below materializes the full [N, 2*128+3] uint32 lane matrix —
+# ~1 GB of transient traffic per 1M-row window, almost all zero padding —
+# while the C pass walks only each row's live depth. Loaded lazily and
+# built on demand like the varint kernel; PARCA_NO_NATIVE_HASH=1 forces
+# the numpy path (which is how tests pin the bit-identity of both).
+_native: ctypes.CDLL | None | bool = False  # False = not yet attempted
+
+
+def _load_native() -> ctypes.CDLL | None:
+    global _native
+    if _native is False:
+        _native = None
+        try:
+            from parca_agent_tpu.native import ensure_built
+
+            lib = ctypes.CDLL(ensure_built("libpavecenc.so", "vecenc.cc"))
+            lib.pa_row_hash.restype = ctypes.c_int64
+            lib.pa_row_hash.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+            _native = lib
+        except Exception as e:  # noqa: BLE001 - fallback is numpy
+            _native = None
+            # One warning, not silence: the lane-matrix numpy path is
+            # several times slower per window at scale (docs/perf.md
+            # "ingest wall") and a host missing g++ would otherwise
+            # regress invisibly.
+            from parca_agent_tpu.utils.log import get_logger
+
+            get_logger("ops.hashing").warn(
+                "native row-hash kernel unavailable; falling back to the "
+                "numpy lane-matrix path", error=repr(e))
+    return _native
+
+
+def _row_hash_native(stacks_u64, pids, user_len, kernel_len,
+                     n_hashes: int):
+    """Native dispatch, or None when the kernel cannot take this input
+    (unavailable, non-contiguous, or too many lanes). Bit-identical to
+    the numpy twin for contract-valid rows (zero-padded past depth —
+    zero lanes contribute coef*0 to a multilinear hash either way)."""
+    lib = _load_native()
+    if lib is None or n_hashes < 1 or n_hashes > N_FAMILIES:
+        return None
+    stacks = stacks_u64
+    if stacks.dtype != np.uint64 or stacks.ndim != 2 \
+            or not stacks.flags.c_contiguous:
+        return None
+    n, slots = stacks.shape
+    k = 2 * slots + 3
+    if k > _MAX_LANES:
+        raise ValueError(f"too many lanes to hash: {k} > {_MAX_LANES}")
+    pids_u = np.ascontiguousarray(pids, np.uint32)
+    ulen_u = np.ascontiguousarray(user_len, np.uint32)
+    klen_u = np.ascontiguousarray(kernel_len, np.uint32)
+    depth = np.ascontiguousarray(
+        np.asarray(user_len, np.int64) + np.asarray(kernel_len, np.int64),
+        np.int32)
+    coefs = np.ascontiguousarray(_COEFS[:n_hashes, :k])
+    biases = np.ascontiguousarray(_BIASES[:n_hashes])
+    out = np.empty((n_hashes, n), np.uint32)
+    ok = lib.pa_row_hash(
+        stacks.ctypes.data, n, slots, pids_u.ctypes.data,
+        ulen_u.ctypes.data, klen_u.ctypes.data, depth.ctypes.data,
+        coefs.ctypes.data, coefs.shape[1], biases.ctypes.data, n_hashes,
+        out.ctypes.data)
+    if ok != -1:  # layout guard tripped (cannot happen from this wrapper)
+        return None
+    return tuple(out)
+
+
 def row_hash_np(stacks_u64: np.ndarray, pids, user_len, kernel_len,
                 n_hashes: int = 2):
     """Host-side (numpy) twin of the device row hash; used by sketches, the
-    dictionary aggregator, and tests to confirm host/device agreement."""
+    dictionary aggregator, and tests to confirm host/device agreement.
+
+    Dispatches to the native batch kernel when available (bit-identical
+    output — the dict aggregator's probe path and every cross-node join
+    key on these exact values); PARCA_NO_NATIVE_HASH=1 pins the numpy
+    lane-matrix fallback."""
+    stacks_u64 = np.asarray(stacks_u64, np.uint64)
+    if not os.environ.get("PARCA_NO_NATIVE_HASH") and len(stacks_u64):
+        got = _row_hash_native(stacks_u64, pids, user_len, kernel_len,
+                               n_hashes)
+        if got is not None:
+            return got
     hi = (stacks_u64 >> np.uint64(32)).astype(np.uint32)
     lo = stacks_u64.astype(np.uint32)
     lanes = fold_u64_rows(

@@ -834,6 +834,18 @@ class CPUProfiler:
             disp = self._feeder.stats.get("last_window_dispatch_s", 0.0)
             if disp:
                 tr.add_span("feed_dispatch_overlap", disp)
+            # The ingest-wall split (docs/perf.md "ingest wall"): what
+            # this window's drains spent HASHING batches vs COALESCING
+            # them to (stack, weight) pairs. Same lockstep contract as
+            # feed/feed_dispatch_overlap: the feeder resets these per
+            # window and pops the aggregator timings that source them,
+            # so an empty or fallback window records nothing stale.
+            hsh = self._feeder.stats.get("last_window_hash_s", 0.0)
+            if hsh:
+                tr.add_span("feed_hash", hsh)
+            co = self._feeder.stats.get("last_window_coalesce_s", 0.0)
+            if co:
+                tr.add_span("feed_coalesce", co)
             if self._feeder.stats.get("last_window_streamed", 0):
                 tr.add_span("fetch",
                             self._feeder.stats.get("last_close_s", 0.0))

@@ -137,10 +137,21 @@ class StreamingWindowFeeder:
                       # checks (the residual wait, ~a completion check
                       # between drains).
                       "last_window_dispatch_s": 0.0,
-                      "last_window_settle_s": 0.0}
+                      "last_window_settle_s": 0.0,
+                      # Ingest-wall split (docs/perf.md "ingest wall"):
+                      # capture-thread seconds this window spent HASHING
+                      # feed batches vs COALESCING them to (stack,
+                      # weight) pairs — the two costs the native kernel
+                      # and the fold exist to shrink. Popped, not read,
+                      # like dispatch/settle: a stale value must never
+                      # re-count into a later window's spans.
+                      "last_window_hash_s": 0.0,
+                      "last_window_coalesce_s": 0.0}
         self._window_feed_s = 0.0
         self._window_dispatch_s = 0.0
         self._window_settle_s = 0.0
+        self._window_hash_s = 0.0
+        self._window_coalesce_s = 0.0
 
     def _discard_open_window(self) -> None:
         """Drop the aggregator's open-window state across buffer flips:
@@ -239,6 +250,8 @@ class StreamingWindowFeeder:
                 if tim is not None:
                     tim.pop("feed_dispatch", None)
                     tim.pop("feed_settle", None)
+                    tim.pop("feed_hash", None)
+                    tim.pop("feed_coalesce", None)
             if self._fed_total == 0 \
                     and (getattr(self._agg, "_fed_total", 0)
                          or getattr(self._agg, "_pending", None)):
@@ -270,6 +283,8 @@ class StreamingWindowFeeder:
             if tim is not None:
                 self._window_dispatch_s += tim.pop("feed_dispatch", 0.0)
                 self._window_settle_s += tim.pop("feed_settle", 0.0)
+                self._window_hash_s += tim.pop("feed_hash", 0.0)
+                self._window_coalesce_s += tim.pop("feed_coalesce", 0.0)
             self._fed_total += mini.total_samples()
             self.stats["drains_fed"] += 1
             if self._encoder is not None and self._prebuild_period:
@@ -327,6 +342,10 @@ class StreamingWindowFeeder:
         self._window_dispatch_s = 0.0
         self.stats["last_window_settle_s"] = self._window_settle_s
         self._window_settle_s = 0.0
+        self.stats["last_window_hash_s"] = self._window_hash_s
+        self._window_hash_s = 0.0
+        self.stats["last_window_coalesce_s"] = self._window_coalesce_s
+        self._window_coalesce_s = 0.0
         self.stats["last_window_streamed"] = 0
         if snapshot.period_ns:
             self._prebuild_period = snapshot.period_ns
@@ -373,5 +392,11 @@ class StreamingWindowFeeder:
                 "feed_dispatch", 0.0)
             self.stats["last_window_settle_s"] += tim.pop(
                 "feed_settle", 0.0)
+            # hash/coalesce are feed-time-only writes, already popped by
+            # the drains — popped again here purely so a stale value
+            # can never survive into the next window's accounting.
+            self.stats["last_window_hash_s"] += tim.pop("feed_hash", 0.0)
+            self.stats["last_window_coalesce_s"] += tim.pop(
+                "feed_coalesce", 0.0)
         self._backoff = self._backoff_base  # healthy again: reset backoff
         return counts

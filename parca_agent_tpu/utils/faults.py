@@ -58,6 +58,12 @@ layer the ship-path components consult at NAMED SITES:
                       (baseline_save_errors / baseline_adopt_errors)
                       and skipped: the sentinel relearns cold, the
                       agent is unharmed
+    feed.coalesce     the host-side (stack, weight) fold of one feed
+                      batch (aggregator/dict.py; docs/perf.md "ingest
+                      wall") — fail-open by contract: an injected fault
+                      is counted (coalesce_fallbacks) and the batch
+                      dispatches UNCOALESCED — identical counts and
+                      pprof bytes, never a lost feed or window
 
 and, on the ingest side (docs/robustness.md "ingest containment" — the
 ``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an
@@ -149,6 +155,7 @@ SITES = {
     "regression.fold": "regression sentinel fold (runtime/regression.py)",
     "regression.baseline":
         "sentinel baseline save/adopt (runtime/regression.py)",
+    "feed.coalesce": "feed-batch (stack, weight) fold (aggregator/dict.py)",
     "elf.read": "ElfFile construction (elf/reader.py)",
     "perfmap.parse": "JIT perf-map read+parse (symbolize/perfmap.py)",
     "maps.parse": "/proc/<pid>/maps parse (process/maps.py)",

@@ -277,6 +277,31 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                  agg_stats.get("delta_retries", 0), lab)
             emit("parca_agent_close_delta_fallbacks_total",
                  agg_stats.get("delta_fallbacks", 0), lab)
+            # Ingest-wall observability (docs/perf.md "ingest wall"):
+            # how hard the feed-batch fold is working — rows in vs rows
+            # actually dispatched (the gap is the cross-thread
+            # repetition coalesced away) and the counted fail-open
+            # fallbacks to the uncoalesced path.
+            emit("parca_agent_feed_coalesce_rows_in_total",
+                 agg_stats.get("coalesce_rows_in", 0), lab)
+            emit("parca_agent_feed_coalesce_rows_out_total",
+                 agg_stats.get("coalesce_rows_out", 0), lab)
+            emit("parca_agent_feed_coalesce_fallbacks_total",
+                 agg_stats.get("coalesce_fallbacks", 0), lab)
+            emit("parca_agent_feed_miss_vec_inserts_total",
+                 agg_stats.get("miss_vec_inserts", 0), lab)
+        feeder = getattr(p, "_feeder", None)
+        if feeder is not None and getattr(feeder, "stats", None):
+            # The ingest ceiling as a first-class number: the fraction
+            # of the window the capture thread spent feeding (feed
+            # seconds / window seconds). At 1.0 the feed IS the window
+            # and the pid axis has hit the ingest wall the coalesced/
+            # native feed path exists to push back.
+            window_s = float(getattr(p, "_duration", 0.0)) or 10.0
+            feed_s = float(feeder.stats.get("last_window_feed_s", 0.0))
+            emit("parca_agent_feed_saturation",
+                 round(feed_s / window_s, 6), lab)
+            emit("parca_agent_feed_seconds", round(feed_s, 6), lab)
         enc = getattr(p, "_encoder", None)
         if enc is not None and getattr(enc, "stats", None):
             # Template dead rows: count-0 samples shipped (wire-size
