@@ -38,7 +38,7 @@ test-live:
 # coverage honest (every SITES entry exercised here, and vice versa),
 # so drift fails fast before any test runs.
 chaos: lint
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py tests/test_feed_coalesce.py -q -m chaos
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py tests/test_feed_coalesce.py tests/test_device_telemetry.py -q -m chaos
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the

@@ -48,6 +48,7 @@ from parca_agent_tpu.capture.formats import (
     fold_rows_first_seen,
 )
 from parca_agent_tpu.ops.hashing import row_hash_np
+from parca_agent_tpu.runtime import device_telemetry as dtel
 from parca_agent_tpu.utils import faults
 
 # Linear-probe bound. The capacity guard keeps load factor <= 0.5, and at
@@ -851,6 +852,18 @@ class DictAggregator:
                             "using the lax probe loop")
                     want = "lax"
             self._probe_resolved = want
+            interp = None
+            if want == "pallas":
+                from parca_agent_tpu.aggregator import pallas_probe
+
+                interp = pallas_probe.default_interpret()
+            # A non-lax request resolving to lax IS the silent fallback
+            # the one-hot gauge exists to surface (docs/observability.md
+            # "device flight recorder").
+            dtel.note_backend(
+                "feed_probe", requested=self._probe_backend, resolved=want,
+                interpret=interp,
+                fallback=(want == "lax" and self._probe_backend != "lax"))
         return self._probe_resolved
 
     def _feed_dispatch_async(self, packed: np.ndarray, n_pad: int,
@@ -859,15 +872,22 @@ class DictAggregator:
         sync; returns an opaque handle for _settle_dispatch. The
         accumulator donation contract: self._acc/_touch are None while
         the dispatch is in flight (invalid if it throws)."""
+        import time as _time
+
         import jax.numpy as jnp
 
+        backend = self._probe_backend_name()
         prog = _feed_program(self._cap, self._id_cap, n_pad,
-                             self._n_blocks, self._blk,
-                             self._probe_backend_name())
+                             self._n_blocks, self._blk, backend)
+        # The feed program's jit cache key doubles as the telemetry
+        # shape signature: a new key is the dispatch that pays compile.
+        sig = (self._cap, self._id_cap, n_pad, self._n_blocks, self._blk,
+               backend)
         acc = self._acc
         touch = self._touch if self._blk else jnp.zeros(1, jnp.int32)
         self._acc = None    # donated: invalid if the call throws
         self._touch = None
+        t0 = _time.perf_counter()
         try:
             acc, touch, n_miss, miss_rows = prog(
                 self._dev, acc, touch, jnp.asarray(packed),
@@ -884,6 +904,7 @@ class DictAggregator:
             # the held acc/touch: a lowering failure raises at compile,
             # before donation consumes the buffers.
             self._probe_resolved = "lax"
+            dtel.note_backend("feed_probe", resolved="lax", fallback=True)
             from parca_agent_tpu.utils.log import get_logger
 
             get_logger("aggregator.dict").warn(
@@ -891,9 +912,13 @@ class DictAggregator:
                 "probe loop", error=repr(e)[:200])
             prog = _feed_program(self._cap, self._id_cap, n_pad,
                                  self._n_blocks, self._blk, "lax")
+            sig = (self._cap, self._id_cap, n_pad, self._n_blocks,
+                   self._blk, "lax")
             acc, touch, n_miss, miss_rows = prog(
                 self._dev, acc, touch, jnp.asarray(packed),
                 jnp.uint32(reset))
+        dtel.record("feed_probe", _time.perf_counter() - t0, shape=sig,
+                    h2d_bytes=packed.nbytes)
         self._acc = acc
         self._touch = touch if self._blk else None
         return (n_miss, miss_rows)
@@ -912,19 +937,43 @@ class DictAggregator:
     def _close_pack_dispatch(self, acc, n_fetch: int, width: int,
                              n_over_buf: int):
         """Dispatch the full close pack program (no host sync)."""
+        import time as _time
+
         prog = _close_program(self._id_cap, n_fetch, width, n_over_buf)
-        return prog(acc)
+        t0 = _time.perf_counter()
+        out = prog(acc)
+        dtel.record("close_pack", _time.perf_counter() - t0,
+                    shape=(self._id_cap, n_fetch, width, n_over_buf))
+        return out
 
     def _close_pack_collect(self, out_dev) -> np.ndarray:
         """Fetch a dispatched close pack's packed buffer."""
-        return np.asarray(out_dev)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        host = np.asarray(out_dev)
+        # Execute-only (shape=None): the fetch is a collect, not a
+        # dispatch — its compile truth already lives in the pack/delta
+        # signatures above, and latching the output shape here would
+        # re-report every legitimate delta<->full geometry switch as a
+        # recompile storm.
+        dtel.record("close_fetch", _time.perf_counter() - t0,
+                    d2h_bytes=host.nbytes)
+        return host
 
     def _close_delta_dispatch(self, acc, touch, n_fetch: int, width: int,
                               n_over_buf: int, n_blk_buf: int):
         """Dispatch the delta close pack program (no host sync)."""
+        import time as _time
+
         prog = _close_program_delta(self._id_cap, n_fetch, width,
                                     n_over_buf, n_blk_buf, self._blk)
-        return prog(acc, touch)
+        t0 = _time.perf_counter()
+        out = prog(acc, touch)
+        dtel.record("close_delta", _time.perf_counter() - t0,
+                    shape=(self._id_cap, n_fetch, width, n_over_buf,
+                           n_blk_buf, self._blk))
+        return out
 
     def _pick_close_width(self) -> int:
         """Packing width for this close: the narrowest that provably (from
@@ -1367,8 +1416,18 @@ class DictAggregator:
         wts = (np.asarray(weights, np.int64) if weights is not None
                else snapshot.counts[rows].astype(np.int64))
         if len(rows) >= _VEC_MISS_MIN:
+            import time as _time
+
+            t0 = _time.perf_counter()
             out = self._resolve_misses_vec(snapshot, rows, h1, h2, h3, wts)
             if out is not None:
+                # Shape class = the miss batch's pow2 envelope: the
+                # commit's device scatter compiles per insert-count, so
+                # the exact count would read every varied batch as a
+                # recompile; the envelope keeps the latch meaningful.
+                dtel.record("miss_settle", _time.perf_counter() - t0,
+                            shape=(1 << max(0, (len(rows)
+                                                - 1).bit_length()),))
                 return out
             self.stats["miss_vec_fallbacks"] = \
                 self.stats.get("miss_vec_fallbacks", 0) + 1
@@ -1683,6 +1742,7 @@ class DictAggregator:
 
         self._dev = self._dev.at[jnp.asarray(slots.astype(np.int32))].set(
             jnp.asarray(vals))
+        dtel.transfer("miss_settle", "h2d", 4 * len(slots) + vals.nbytes)
 
     def _check_insert_room(self, classified, seen_batch) -> None:
         """Pre-mutation room validation hook for subclasses with placement

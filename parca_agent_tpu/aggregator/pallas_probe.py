@@ -84,8 +84,14 @@ def make_batch_probe(cap: int, probes: int, interpret: bool | None = None):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    from parca_agent_tpu.runtime import device_telemetry as dtel
+
     if interpret is None:
         interpret = default_interpret()
+    # The interpret decision is made here, so the flight recorder's
+    # per-kernel interpret gauge is latched here (free when telemetry
+    # is off; the call sites latch requested/resolved/fallback).
+    dtel.note_backend("feed_probe", interpret=interpret)
 
     def kernel(table_ref, h1_ref, h2_ref, h3_ref, out_ref):
         # Scalar constants are built INSIDE the kernel: a jnp scalar
@@ -142,8 +148,11 @@ def make_loc_table_builder(f_cap: int, cap_l: int,
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    from parca_agent_tpu.runtime import device_telemetry as dtel
+
     if interpret is None:
         interpret = default_interpret()
+    dtel.note_backend("loc_dedup", interpret=interpret)
     # Any unplaced lane advances at least once per two iterations (one
     # iteration may be spent re-reading a slot a claim winner just
     # filled), so 2*cap_l + 2 bounds every terminating run; a genuinely

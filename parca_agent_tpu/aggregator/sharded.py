@@ -41,6 +41,7 @@ from parca_agent_tpu.aggregator.dict import (
     make_close,
 )
 from parca_agent_tpu.parallel.mesh import FLEET_AXIS, fleet_mesh
+from parca_agent_tpu.runtime import device_telemetry as dtel
 
 
 def route_h2(h2: np.ndarray, pids, shard_of_pid, n_shards: int
@@ -392,16 +393,19 @@ class ShardedDictAggregator(DictAggregator):
         serially-staged global copy. Counted fallback to the single
         staged device_put on any runtime refusal (layouts, committed
         device sets) — never a lost feed."""
+        import time as _time
+
         import jax
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
         sharding = NamedSharding(self._mesh, P(FLEET_AXIS, None, None))
+        t0 = _time.perf_counter()
         try:
             devs = list(self._mesh.devices.reshape(-1))
             shards = [jax.device_put(part[s:s + 1], d)
                       for s, d in enumerate(devs)]
-            return jax.make_array_from_single_device_arrays(
+            out = jax.make_array_from_single_device_arrays(
                 part.shape, sharding, shards)
         except Exception as e:  # noqa: BLE001 - counted fallback
             self.stats["shard_put_fallbacks"] = \
@@ -411,7 +415,10 @@ class ShardedDictAggregator(DictAggregator):
             get_logger("aggregator.sharded").warn(
                 "per-shard concurrent device_put failed; using the "
                 "staged global copy", error=repr(e)[:200])
-            return jax.device_put(part, sharding)
+            out = jax.device_put(part, sharding)
+        dtel.record("shard_put", _time.perf_counter() - t0,
+                    shape=tuple(part.shape), h2d_bytes=part.nbytes)
+        return out
 
     # palint: capture-path — the sharded override of the dispatch-only
     # feed (the base seed's call graph stops at file scope, so the
@@ -419,14 +426,20 @@ class ShardedDictAggregator(DictAggregator):
     # palint: device-state: _dev, _acc, _touch, _acc_spare, _touch_spare
     def _feed_dispatch_async(self, packed: np.ndarray, n_pad: int,
                              reset: int):
+        import time as _time
+
         part = self._partition_packed(packed)
         prog = _sharded_feed_program(self._mesh, self._n_shards, self._cap_s,
                                      self._id_cap, part.shape[2])
         dev_packed = self._device_put_sharded(part)
         acc = self._acc
         self._acc = None  # donated: invalid if the call throws
+        t0 = _time.perf_counter()
         acc, n_miss, miss_rows = prog(self._dev, acc, dev_packed,
                                       np.uint32(reset))
+        dtel.record("feed_probe", _time.perf_counter() - t0,
+                    shape=("sharded", self._n_shards, self._cap_s,
+                           self._id_cap, part.shape[2]))
         self._acc = acc
         return (n_miss, miss_rows)
 
@@ -445,13 +458,28 @@ class ShardedDictAggregator(DictAggregator):
 
     def _close_pack_dispatch(self, acc, n_fetch: int, width: int,
                              n_over_buf: int):
+        import time as _time
+
         prog = _sharded_close_program(self._mesh, self._n_shards,
                                       self._id_cap, n_fetch, width,
                                       n_over_buf)
-        return prog(acc)[0]  # every shard holds the same packed copy
+        t0 = _time.perf_counter()
+        out = prog(acc)[0]  # every shard holds the same packed copy
+        dtel.record("close_pack", _time.perf_counter() - t0,
+                    shape=("sharded", self._n_shards, self._id_cap,
+                           n_fetch, width, n_over_buf))
+        return out
 
     def _close_pack_collect(self, out_dev) -> np.ndarray:
-        return np.asarray(out_dev)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        host = np.asarray(out_dev)
+        # Execute-only, same reasoning as the base collect: the compile
+        # truth lives in the pack signature, not the fetched shape.
+        dtel.record("close_fetch", _time.perf_counter() - t0,
+                    d2h_bytes=host.nbytes)
+        return host
 
     def _dev_scatter(self, slots: np.ndarray, vals: np.ndarray) -> None:
         import jax.numpy as jnp
@@ -460,4 +488,5 @@ class ShardedDictAggregator(DictAggregator):
         w_idx = (slots % self._cap_s).astype(np.int32)
         self._dev = self._dev.at[jnp.asarray(s_idx), jnp.asarray(w_idx)].set(
             jnp.asarray(vals))
+        dtel.transfer("miss_settle", "h2d", 8 * len(slots) + vals.nbytes)
 

@@ -47,6 +47,7 @@ from parca_agent_tpu.capture.formats import (
     fold_rows_first_seen,
 )
 from parca_agent_tpu.ops.hashing import fold_u64_rows, multilinear_hash_u32
+from parca_agent_tpu.runtime import device_telemetry as dtel
 
 _U32_MAX = 0xFFFFFFFF
 
@@ -495,10 +496,15 @@ class TPUAggregator:
 
     def _use_hash(self) -> bool:
         if self._hash_disabled or self.dedup == "sort":
+            dtel.note_backend("loc_dedup", requested=self.dedup,
+                              resolved="lax",
+                              fallback=self._hash_disabled)
             return False
         from parca_agent_tpu.aggregator.pallas_probe import pallas_available
 
         if pallas_available():
+            dtel.note_backend("loc_dedup", requested=self.dedup,
+                              resolved="pallas", fallback=False)
             return True
         if self.dedup == "hash":
             from parca_agent_tpu.utils.log import get_logger
@@ -507,6 +513,10 @@ class TPUAggregator:
                 "hash dedup requested but Pallas is unavailable; using "
                 "the lax sort kernel")
         self._hash_disabled = True
+        # Pallas wanted (auto/hash) but unavailable: the latched
+        # fallback the one-hot gauge surfaces.
+        dtel.note_backend("loc_dedup", requested=self.dedup,
+                          resolved="lax", fallback=True)
         return False
 
     def aggregate(self, snapshot: WindowSnapshot) -> list[PidProfile]:
@@ -523,13 +533,16 @@ class TPUAggregator:
 
         while True:
             try:
+                import time as _time
+
                 from parca_agent_tpu.aggregator.pallas_probe import (
                     default_interpret,
                 )
 
+                interp = default_interpret()
+                t0 = _time.perf_counter()
                 out = _jitted_kernel()(*dev_args, hash_locs=use_hash,
-                                       interpret=default_interpret(),
-                                       **dims)
+                                       interpret=interp, **dims)
             except Exception as e:  # noqa: BLE001 - hash path only
                 if not use_hash:
                     raise
@@ -539,14 +552,28 @@ class TPUAggregator:
                 # per-window hot path does not retry a broken lowering.
                 self._hash_disabled = True
                 use_hash = False
+                dtel.note_backend("loc_dedup", resolved="lax",
+                                  fallback=True)
                 from parca_agent_tpu.utils.log import get_logger
 
                 get_logger("aggregator.tpu").warn(
                     "hash location dedup failed; falling back to the lax "
                     "sort kernel", error=repr(e)[:200])
                 continue
+            outs = tuple(map(np.asarray, out))
             (n_groups, n_locs, out_pid, depth, values, loc_ids,
-             loc_pid, loc_hi, loc_lo, loc_map_row) = map(np.asarray, out)
+             loc_pid, loc_hi, loc_lo, loc_map_row) = outs
+            # One observation covers dispatch + fetch (the one-shot
+            # kernel is synchronous by design); the jit static key is
+            # the shape signature, so every l_cap doubling retry reads
+            # as the recompile it really is.
+            dtel.record(
+                "loc_dedup", _time.perf_counter() - t0,
+                shape=(dims["n_pad"], dims["l_cap"], dims["m_pad"],
+                       dims["f_cap"], use_hash, interp),
+                h2d_bytes=sum(int(a.nbytes) for a in host_args),
+                d2h_bytes=sum(int(a.nbytes) for a in outs))
+            dtel.note_backend("loc_dedup", interpret=interp)
             if int(n_locs) <= dims["l_cap"]:
                 break
             dims["l_cap"] *= 2

@@ -97,6 +97,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-incident-interval", type=float, default=300.0,
                    help="minimum seconds between incident captures "
                         "(rate limit; suppressed captures are counted)")
+    p.add_argument("--no-device-telemetry", action="store_true",
+                   help="disable the device flight recorder "
+                        "(docs/observability.md \"device flight "
+                        "recorder\"): per-kernel compile/execute "
+                        "latency histograms, recompile-storm detection, "
+                        "H2D/D2H transfer accounting, and window-SLO "
+                        "budget burn on /metrics + /debug/device. On by "
+                        "default — the bench's telemetry_overhead phase "
+                        "holds the tax under 1%% of the close")
+    p.add_argument("--telemetry-ring", type=int, default=256,
+                   help="kernel events and window-SLO entries kept in "
+                        "the device flight recorder's timeline rings "
+                        "(/debug/device)")
     p.add_argument("--quarantine-max-strikes", type=int, default=3,
                    help="ingest containment: per-pid input faults "
                         "tolerated per budget window before the pid is "
@@ -950,6 +963,25 @@ def run(argv=None) -> int:
             incident_interval_s=args.trace_incident_interval)
         trace_mod.install(recorder)
 
+    # -- device flight recorder (docs/observability.md "device flight
+    # recorder") -------------------------------------------------------------
+    # The host recorder's device-side twin: per-kernel compile/execute
+    # histograms with recompile-storm detection, transfer-byte
+    # accounting, latched backend identity, and the window-SLO budget
+    # layer keyed to the configured profiling period. Installed
+    # process-globally so the kernel dispatch sites in
+    # aggregator/{dict,tpu,sharded}.py report without plumbing; storms
+    # route through the window recorder's incident machinery above.
+    device_telemetry = None
+    if not args.no_device_telemetry:
+        from parca_agent_tpu.runtime import device_telemetry as dtel_mod
+
+        device_telemetry = dtel_mod.DeviceTelemetry(
+            period_s=args.profiling_duration,
+            ring=args.telemetry_ring,
+            incident_interval_s=args.trace_incident_interval)
+        dtel_mod.install(device_telemetry)
+
     # -- warm statics snapshot (docs/perf.md "the statics wall") -------------
     statics_store = None
     if args.statics_snapshot_path:
@@ -1268,7 +1300,8 @@ def run(argv=None) -> int:
                            hotspots=hotspot_store,
                            sinks=sink_registry,
                            admission=admission,
-                           regression=regression_sentinel)
+                           regression=regression_sentinel,
+                           device_telemetry=device_telemetry)
 
     # -- config hot reload ---------------------------------------------------
     reloader = None

@@ -23,6 +23,7 @@ from typing import Callable, Protocol
 from parca_agent_tpu.aggregator.base import Aggregator, PidProfile
 from parca_agent_tpu.capture.formats import WindowSnapshot
 from parca_agent_tpu.pprof.builder import build_pprof
+from parca_agent_tpu.runtime import device_telemetry as dtel
 from parca_agent_tpu.runtime.quarantine import apply_ladder
 from parca_agent_tpu.runtime.trace import NULL_TRACE
 from parca_agent_tpu.utils import faults
@@ -480,6 +481,7 @@ class CPUProfiler:
 
     def run_iteration(self) -> bool:
         """Returns False when the source is exhausted."""
+        t_iter0 = time.perf_counter()
         tr = (self._recorder.begin() if self._recorder is not None
               else NULL_TRACE)
         try:
@@ -588,6 +590,13 @@ class CPUProfiler:
             # cooldowns and re-probe scheduling advance per window.
             self._health.tick_window()
         self.metrics.last_attempt_duration_s = time.perf_counter() - t_start
+        # Window-SLO accounting (runtime/device_telemetry.py): the
+        # capture thread's busy wall for this window — drain through
+        # hand-off plus the per-window ticks above — judged against the
+        # configured period. run() sleeps out the remainder, so this is
+        # the window's whole non-idle cost on this thread; off-thread
+        # kernel seconds are folded in by the telemetry layer itself.
+        dtel.tick_window(time.perf_counter() - t_iter0)
         self._manage_gc(self.metrics.attempts_total)
         if self._on_iteration is not None:
             self._on_iteration(self.metrics.attempts_total)

@@ -46,6 +46,7 @@ import sys
 import threading
 import time
 
+from parca_agent_tpu.runtime import device_telemetry as dtel
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
@@ -246,6 +247,8 @@ class DeviceHealthRegistry:
                 self.wedged_at = None
                 self.last_promote_window = self.windows
                 self.stats["promotions_total"] += 1
+                dtel.note_backend("device", resolved="device",
+                                  fallback=False)
                 _log.info("device promoted: shadow window matched the "
                           "CPU fallback", window=self.windows,
                           trips_survived=trips_survived)
@@ -373,6 +376,10 @@ class DeviceHealthRegistry:
             return
         prev = self.state
         self.state = STATE_DEGRADED
+        # Latch the demotion into the device flight recorder's backend
+        # gauges: a node running its windows on the CPU fallback must be
+        # visible from /metrics next to the per-kernel pallas/lax state.
+        dtel.note_backend("device", resolved="cpu_fallback", fallback=True)
         if prev != STATE_DEGRADED:
             _log.warn("device demoted to the CPU fallback", reason=reason,
                       window=self.windows, cooldown_windows=self.cooldown_left,

@@ -506,12 +506,41 @@ class FlightRecorder:
             target=self._dump_incident, args=(trace, stage, dur, budget),
             name="trace-incident", daemon=True).start()
 
+    def capture_event(self, kind: str, stage: str, detail: dict) -> bool:
+        """External incident capture — the device flight recorder routes
+        recompile storms here (runtime/device_telemetry.py). Same rate
+        limiter, single-flight daemon thread, context/self-profile
+        bundle, and pruning as slow-window capture; the incident file
+        carries the caller's ``kind`` and ``detail`` payload. Returns
+        False when suppressed (rate limit, capture in flight, no
+        incident dir)."""
+        with self._lock:
+            now = self._clock()
+            if self._dumping or (
+                    self._last_incident_at is not None
+                    and now - self._last_incident_at
+                    < self._incident_interval):
+                self.stats["incidents_suppressed"] += 1
+                return False
+            self._last_incident_at = now
+            if not self._incident_dir:
+                self.stats["incidents_suppressed"] += 1
+                return False
+            self._dumping = True
+        _log.warn("external incident; capturing", kind=kind, stage=stage)
+        threading.Thread(
+            target=self._dump_incident, args=(None, stage, 0.0, 0.0),
+            kwargs={"kind": kind, "detail": detail},
+            name="trace-incident", daemon=True).start()
+        return True
+
     def _dump_incident(self, trace, stage: str, dur: float,
-                       budget: float) -> None:
+                       budget: float, kind: str = "slow_window",
+                       detail: dict | None = None) -> None:
         try:
             faults.inject("incident.dump")
             body = {
-                "kind": "slow_window",
+                "kind": kind,
                 "stage": stage,
                 "duration_s": round(dur, 6),
                 "budget_s": round(budget, 6),
@@ -520,6 +549,8 @@ class FlightRecorder:
                 "trace": trace.to_dict() if trace is not None else None,
                 "stage_percentiles": self.percentiles(),
             }
+            if detail is not None:
+                body["detail"] = detail
             if self._context is not None:
                 try:
                     body["context"] = self._context()

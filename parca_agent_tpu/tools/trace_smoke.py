@@ -12,6 +12,15 @@ and asserts the observability contract (docs/observability.md):
      primed p99 budget) produces EXACTLY ONE incident file containing
      the offending trace and a self-profile — and zero windows are
      lost.
+  4. The device flight recorder (docs/observability.md "device flight
+     recorder") latched >= 1 compile per exercised kernel during the
+     primed session, with zero recompiles on the pinned geometry, and
+     `/metrics` serves the kernel/transfer/window-budget families with
+     compile and execute separable.
+  5. `/debug/device` returns the telemetry snapshot + timeline.
+  6. One injected shape change (a window at a different row count — a
+     new feed signature on a latched kernel) produces EXACTLY ONE
+     `recompile_storm` incident file — and still zero windows lost.
 
 Exit 0 on success; raises (exit 1) with a readable assertion otherwise.
 Host-side only: the Make target pins JAX_PLATFORMS=cpu.
@@ -43,6 +52,7 @@ def main() -> int:
         MANDATORY_SPANS,
         FlightRecorder,
     )
+    from parca_agent_tpu.runtime import device_telemetry as dtel_mod
     from parca_agent_tpu.runtime import trace as trace_mod
     from parca_agent_tpu.utils import faults
     from parca_agent_tpu.web import AgentHTTPServer
@@ -81,19 +91,34 @@ def main() -> int:
     recorder = FlightRecorder(
         ring=64, min_count=4, min_duration_s=0.05, slow_multiple=5.0,
         incident_dir=incident_dir,
+        # Short enough that the recompile drill's capture (6 below) is
+        # not rate-suppressed by the slow-window incident (3) before it.
+        incident_interval_s=0.5,
         # A fast self-profile keeps the smoke quick; the incident still
         # carries a REAL gzipped pprof of the agent's threads.
         self_profile=None, self_profile_s=0.3,
         context=lambda: {"smoke": True})
     trace_mod.install(recorder)
 
+    # The device flight recorder rides the whole primed session: install
+    # AFTER the pre-warm above (whose one-shot geometry would latch a
+    # second signature) so the primed loop's pinned geometry latches
+    # exactly one signature per kernel. Its own incident pre-filter is
+    # effectively off (one per hour) — the shape-change drill below must
+    # surface exactly its FIRST recompile.
+    dtel = dtel_mod.DeviceTelemetry(
+        period_s=1.0, ring=256, incident_interval_s=3600.0)
+    dtel_mod.install(dtel)
+
+    src = Src()
     prof = CPUProfiler(
-        source=Src(), aggregator=agg,
+        source=src, aggregator=agg,
         fallback_aggregator=CPUAggregator(), profile_writer=Sink(),
         duration_s=0.0, fast_encode=True, encode_pipeline=True,
         trace_recorder=recorder)
 
-    http = AgentHTTPServer(port=0, profilers=[prof], recorder=recorder)
+    http = AgentHTTPServer(port=0, profilers=[prof], recorder=recorder,
+                           device_telemetry=dtel)
     http.start()
     base = f"http://127.0.0.1:{http.port}"
 
@@ -133,12 +158,57 @@ def main() -> int:
               f"{len(stages_in_metrics)} stages: "
               f"{sorted(stages_in_metrics)}")
 
+        # -- device flight recorder: primed-session truth --------------------
+        snap_t = dtel.snapshot()
+        kernels = snap_t["kernels"]
+        assert kernels, "device telemetry saw no kernel dispatches"
+        assert "feed_probe" in kernels, f"no feed_probe in {sorted(kernels)}"
+        latched = {n for n, i in kernels.items() if i["shapes_latched"]}
+        assert "feed_probe" in latched
+        for name in latched:
+            assert kernels[name]["compiles"] >= 1, \
+                f"kernel {name} latched no compile: {kernels[name]}"
+        assert snap_t["stats"]["recompiles_total"] == 0, \
+            f"pinned geometry recompiled: {snap_t['stats']}"
+        assert snap_t["stats"]["record_errors"] == 0
+        assert snap_t["window_budget"]["windows_total"] >= n_prime
+        assert any(d.get("h2d") or d.get("d2h")
+                   for d in snap_t["transfers"].values()), \
+            f"no transfer bytes accounted: {snap_t['transfers']}"
+        for family in ("parca_agent_kernel_duration_seconds",
+                       "parca_agent_kernel_compiles_total",
+                       "parca_agent_transfer_bytes_total",
+                       "parca_agent_window_budget_windows_total",
+                       "parca_agent_device_info"):
+            assert f"# TYPE {family} " in metrics, \
+                f"family {family} missing from /metrics"
+        kernel_events = {
+            (line.split('kernel="', 1)[1].split('"', 1)[0],
+             line.split('event="', 1)[1].split('"', 1)[0])
+            for line in metrics.splitlines()
+            if line.startswith(
+                "parca_agent_kernel_duration_seconds_count")}
+        assert any(e == "compile" for _, e in kernel_events) \
+            and any(e == "execute" for _, e in kernel_events), \
+            f"compile/execute not separable in /metrics: {kernel_events}"
+        device = json.loads(fetch("/debug/device"))
+        assert device["identity"]["platform"]
+        assert device["kernels"] and device["timeline"]["events"]
+        print(f"trace-smoke: device telemetry latched "
+              f"{sorted(kernels)} ({sum(i['compiles'] for i in kernels.values())}"
+              f" compiles, 0 recompiles), "
+              f"{len(device['timeline']['events'])} timeline events")
+
         # -- injected slow window --------------------------------------------
-        # A 400 ms device.dispatch hang: ~2 orders of magnitude over the
-        # primed close p99, well under the 60 s watchdog — the window
-        # still ships, the detector fires, exactly one incident lands.
+        # An 8 s device.dispatch hang: the primed close p99 is
+        # compile-inflated (the first loop windows pay real XLA compiles
+        # for the delta/feed programs, and a loaded CI host has pushed
+        # that tail past 400 ms), so the 5x budget can reach ~2 s — the
+        # hang must clear it decisively while staying well under the
+        # 60 s watchdog. The window still ships, the detector fires,
+        # exactly one incident lands.
         faults.install(faults.FaultInjector.from_spec(
-            "device.dispatch:hang:ms=400,count=1"))
+            "device.dispatch:hang:ms=8000,count=1"))
         try:
             assert prof.run_iteration()
             assert prof._pipeline.flush(30)
@@ -177,11 +247,53 @@ def main() -> int:
         print(f"trace-smoke: slow window produced exactly 1 incident "
               f"({files[0]}), slow stage "
               f"{one['meta']['slow_stage']!r}, windows_lost=0")
+
+        # -- injected shape change -> one recompile incident -----------------
+        # A window at twice the row count is a NEW feed signature on the
+        # latched feed_probe kernel: the detector must count it and land
+        # exactly one recompile_storm incident (the telemetry pre-filter
+        # admits only its first recompile; the recorder's 0.5 s interval
+        # has passed since the slow-window capture above).
+        time.sleep(0.6)
+        src.snaps.append(generate(SyntheticSpec(
+            n_pids=6, n_unique_stacks=512, n_rows=512,
+            total_samples=2048, mean_depth=8, seed=500)))
+        assert prof.run_iteration()
+        assert prof._pipeline.flush(30)
+        assert dtel.stats["recompiles_total"] >= 1, \
+            f"shape change latched no recompile: {dtel.stats}"
+
+        deadline = time.monotonic() + 15
+        storms = []
+        while time.monotonic() < deadline:
+            names = (sorted(os.listdir(incident_dir))
+                     if os.path.isdir(incident_dir) else [])
+            storms = []
+            for name in names:
+                with open(os.path.join(incident_dir, name)) as f:
+                    body = json.load(f)
+                if body["kind"] == "recompile_storm":
+                    storms.append((name, body))
+            if storms and not recorder._dumping:
+                break
+            time.sleep(0.05)
+        assert len(storms) == 1, \
+            f"expected exactly 1 recompile incident, got " \
+            f"{[n for n, _ in storms]}"
+        storm = storms[0][1]
+        assert storm["detail"]["kernel"] == "feed_probe", storm["detail"]
+        assert storm["detail"]["shapes_latched"] >= 2
+        assert prof._pipeline.stats["windows_lost"] == 0
+        assert prof.metrics.attempts_total == n_prime + 2
+        print(f"trace-smoke: shape change produced exactly 1 recompile "
+              f"incident ({storms[0][0]}, kernel "
+              f"{storm['detail']['kernel']!r}), windows_lost=0")
         print("trace-smoke: PASS")
         return 0
     finally:
         http.stop()
         trace_mod.install(None)
+        dtel_mod.install(None)
 
 
 if __name__ == "__main__":

@@ -64,6 +64,13 @@ layer the ship-path components consult at NAMED SITES:
                       is counted (coalesce_fallbacks) and the batch
                       dispatches UNCOALESCED — identical counts and
                       pprof bytes, never a lost feed or window
+    device.telemetry  every device flight-recorder entry point
+                      (runtime/device_telemetry.py record /
+                      record_transfer / note_backend / tick_window) —
+                      fail-open like trace.record: an injected fault is
+                      swallowed and counted (record_errors) and must
+                      never cost a window or change a pprof byte
+                      (docs/observability.md "device flight recorder")
 
 and, on the ingest side (docs/robustness.md "ingest containment" — the
 ``poison`` kind raises an InjectedPoison, which IS a PoisonInput, so an
@@ -165,6 +172,8 @@ SITES = {
     "device.dispatch": "guarded device aggregation (profiler/cpu.py)",
     "fleet.join": "jax.distributed fleet join (parallel/distributed.py)",
     "fleet.collective": "one fleet merge/re-probe collective round",
+    "device.telemetry":
+        "device flight-recorder entry points (runtime/device_telemetry.py)",
 }
 
 

@@ -209,7 +209,8 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    supervisor=None, quarantine=None,
                    device_health=None, statics_store=None,
                    recorder=None, hotspots=None, sinks=None,
-                   admission=None, regression=None) -> str:
+                   admission=None, regression=None,
+                   device_telemetry=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics and
     the window flight recorder's stage histograms
@@ -419,6 +420,69 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
         for k, v in recorder.stats.items():
             name = f"parca_agent_trace_{k}"
             emit(name if name.endswith("_total") else name + "_total", v)
+    if device_telemetry is not None:
+        # The DEVICE flight recorder (docs/observability.md "device
+        # flight recorder"): latched backend identity as an info-style
+        # gauge, per-kernel latency histograms split compile|execute
+        # (the separation the wall-clock stage histograms above cannot
+        # see), shape-latch/recompile counters, one-hot backend
+        # resolution per kernel, H2D/D2H transfer accounting, and the
+        # window-SLO budget layer.
+        ident = device_telemetry.ensure_identity()
+        if ident:
+            emit("parca_agent_device_info", 1,
+                 {k: str(v) for k, v in sorted(ident.items())})
+        khists = device_telemetry.export_kernel_histograms()
+        for kernel, event, h in khists:
+            buf.histogram("parca_agent_kernel_duration_seconds",
+                          {"kernel": kernel, "event": event}, h)
+        for kernel, event, h in khists:
+            lab = {"kernel": kernel, "event": event}
+            emit("parca_agent_kernel_p50_seconds",
+                 round(h["p50_s"], 6), lab)
+            emit("parca_agent_kernel_p99_seconds",
+                 round(h["p99_s"], 6), lab)
+            emit("parca_agent_kernel_max_seconds",
+                 round(h["max_s"], 6), lab)
+            if event == "compile":
+                emit("parca_agent_kernel_compiles_total", h["count"],
+                     {"kernel": kernel})
+        for kernel, n in device_telemetry.shape_counts().items():
+            emit("parca_agent_kernel_shapes", n, {"kernel": kernel})
+            emit("parca_agent_kernel_recompiles_total", max(0, n - 1),
+                 {"kernel": kernel})
+        for kernel, rec in device_telemetry.backends().items():
+            resolved = rec["resolved"] or "unresolved"
+            # One-hot over the candidate backends plus whatever this
+            # kernel actually resolved to (the device-health kernel
+            # reports device/cpu_fallback rather than pallas/lax).
+            for backend in sorted({"pallas", "lax", resolved}):
+                emit("parca_agent_kernel_backend",
+                     int(backend == resolved),
+                     {"kernel": kernel, "backend": backend})
+            emit("parca_agent_kernel_fallback", int(rec["fallback"]),
+                 {"kernel": kernel})
+            if rec["interpret"] is not None:
+                emit("parca_agent_kernel_interpret",
+                     int(rec["interpret"]), {"kernel": kernel})
+        for kernel, direction, nbytes, ops in device_telemetry.transfers():
+            lab = {"kernel": kernel, "direction": direction}
+            emit("parca_agent_transfer_bytes_total", nbytes, lab)
+            emit("parca_agent_transfer_ops_total", ops, lab)
+        budget = device_telemetry.budget_export()
+        buf.histogram("parca_agent_window_budget_used_ratio", {},
+                      budget["hist"])
+        emit("parca_agent_window_budget_period_seconds",
+             budget["period_s"])
+        emit("parca_agent_window_budget_windows_total",
+             budget["windows_total"])
+        emit("parca_agent_window_budget_windows_over_total",
+             budget["windows_over_budget_total"])
+        emit("parca_agent_window_budget_used_last_ratio",
+             round(budget["budget_used_last"], 6))
+        for k, v in dict(device_telemetry.stats).items():
+            name = f"parca_agent_device_telemetry_{k}"
+            emit(name if name.endswith("_total") else name + "_total", v)
     if hotspots is not None:
         # Hotspot rollup observability (docs/hotspots.md): per-level
         # ring population/footprint/evictions for BOTH scopes, fold and
@@ -546,7 +610,7 @@ class AgentHTTPServer:
                  capture_info=None, supervisor=None, quarantine=None,
                  device_health=None, statics_store=None, recorder=None,
                  hotspots=None, sinks=None, admission=None,
-                 regression=None):
+                 regression=None, device_telemetry=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -579,7 +643,8 @@ class AgentHTTPServer:
                         hotspots=outer.hotspots,
                         sinks=outer.sinks,
                         admission=outer.admission,
-                        regression=outer.regression).encode())
+                        regression=outer.regression,
+                        device_telemetry=outer.device_telemetry).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
@@ -592,6 +657,8 @@ class AgentHTTPServer:
                     self._diff(url)
                 elif url.path == "/debug/windows":
                     self._debug_windows(url)
+                elif url.path == "/debug/device":
+                    self._debug_device(url)
                 elif url.path.startswith("/debug/trace/"):
                     self._debug_trace(url)
                 elif url.path.startswith("/debug/pprof"):
@@ -620,6 +687,30 @@ class AgentHTTPServer:
                     "stats": dict(outer.recorder.stats),
                     "stage_percentiles": outer.recorder.percentiles(),
                 }
+                self._send(200, json.dumps(body, indent=1).encode(),
+                           "application/json")
+
+            def _debug_device(self, url):
+                """The device flight recorder's state as JSON
+                (docs/observability.md "device flight recorder"): the
+                full snapshot (identity, per-kernel compile/execute
+                percentiles, backends, transfers, window budget) plus
+                the bounded kernel-event and window-SLO timelines;
+                ?limit=N caps both rings."""
+                if outer.device_telemetry is None:
+                    self._send(503, b"device telemetry not enabled\n")
+                    return
+                params = dict(urllib.parse.parse_qsl(url.query))
+                try:
+                    limit = int(params.get("limit", "0"))
+                except ValueError:
+                    limit = -1
+                if limit < 0:
+                    self._send(400, b"bad limit parameter\n")
+                    return
+                body = dict(outer.device_telemetry.snapshot())
+                body["timeline"] = outer.device_telemetry.timeline(
+                    limit=limit or None)
                 self._send(200, json.dumps(body, indent=1).encode(),
                            "application/json")
 
@@ -909,6 +1000,7 @@ class AgentHTTPServer:
         self.sinks = sinks
         self.admission = admission
         self.regression = regression
+        self.device_telemetry = device_telemetry
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info

@@ -25,6 +25,34 @@ def _no_leaked_injector():
     faults.install(None)
 
 
+@pytest.fixture()
+def device_telemetry():
+    """Installed device flight recorder for the fallback-latch cases:
+    the latched pallas->lax state must surface as the one-hot backend
+    gauge on /metrics (docs/observability.md "device flight recorder"),
+    not just as a private attribute."""
+    from parca_agent_tpu.runtime import device_telemetry as dtel_mod
+
+    tel = dtel_mod.DeviceTelemetry()
+    dtel_mod.install(tel)
+    yield tel
+    dtel_mod.install(None)
+
+
+def _assert_fallback_gauge(tel, kernel):
+    """The rendered /metrics must carry the latched lax fallback for
+    `kernel` as a one-hot gauge."""
+    from parca_agent_tpu.web import render_metrics
+
+    metrics = render_metrics([], device_telemetry=tel)
+    assert f'parca_agent_kernel_fallback{{kernel="{kernel}"}} 1' \
+        in metrics, metrics
+    assert f'parca_agent_kernel_backend{{kernel="{kernel}",' \
+        f'backend="lax"}} 1' in metrics
+    assert f'parca_agent_kernel_backend{{kernel="{kernel}",' \
+        f'backend="pallas"}} 0' in metrics
+
+
 def _snap(seed=1, rows=512, pids=8, per_row=3):
     return generate(SyntheticSpec(n_pids=pids, n_unique_stacks=rows,
                                   n_rows=rows, total_samples=rows * per_row,
@@ -156,7 +184,8 @@ def test_dict_pallas_probe_matches_lax():
     assert pal.stats["inserts"] == lax.stats["inserts"]
 
 
-def test_dict_probe_backend_falls_back_when_pallas_unavailable(monkeypatch):
+def test_dict_probe_backend_falls_back_when_pallas_unavailable(
+        monkeypatch, device_telemetry):
     from parca_agent_tpu.aggregator import pallas_probe
 
     monkeypatch.setattr(pallas_probe, "pallas_available", lambda: False)
@@ -168,9 +197,11 @@ def test_dict_probe_backend_falls_back_when_pallas_unavailable(monkeypatch):
         c = a.close_window()
         assert a._probe_resolved == "lax"
         assert int(c.sum()) == snap.total_samples()
+    _assert_fallback_gauge(device_telemetry, "feed_probe")
 
 
-def test_dict_probe_runtime_failure_latches_lax(monkeypatch):
+def test_dict_probe_runtime_failure_latches_lax(
+        monkeypatch, device_telemetry):
     """pallas_available() can pass (CPU interpret round-trip) while the
     real lowering later refuses the kernel at first dispatch — the feed
     must latch the lax fallback instead of failing every window
@@ -200,6 +231,7 @@ def test_dict_probe_runtime_failure_latches_lax(monkeypatch):
         # Subsequent windows stay on the lax path without re-raising.
         a.feed(snap, a.hash_rows(snap))
         assert int(a.close_window().sum()) == snap.total_samples()
+        _assert_fallback_gauge(device_telemetry, "feed_probe")
     finally:
         dict_mod._feed_program.cache_clear()
 
@@ -660,7 +692,8 @@ def test_batch_kernel_hash_dedup_matches_sort_bytes():
         b"".join(build_pprof(p, compress=False) for p in ph)
 
 
-def test_batch_kernel_hash_failure_falls_back_to_sort(monkeypatch):
+def test_batch_kernel_hash_failure_falls_back_to_sort(
+        monkeypatch, device_telemetry):
     """A Pallas build/lowering failure at dispatch degrades to the lax
     sort kernel — same profiles, and the fallback is latched so the hot
     path doesn't retry a broken lowering every window."""
@@ -680,9 +713,11 @@ def test_batch_kernel_hash_failure_falls_back_to_sort(monkeypatch):
     # Latched: the second window never re-enters the hash path.
     profs2 = t.aggregate(snap)
     assert sum(p.total() for p in profs2) == snap.total_samples()
+    _assert_fallback_gauge(device_telemetry, "loc_dedup")
 
 
-def test_batch_kernel_hash_unavailable_uses_sort(monkeypatch):
+def test_batch_kernel_hash_unavailable_uses_sort(
+        monkeypatch, device_telemetry):
     from parca_agent_tpu.aggregator import pallas_probe
     from parca_agent_tpu.aggregator.tpu import TPUAggregator
 
@@ -693,3 +728,4 @@ def test_batch_kernel_hash_unavailable_uses_sort(monkeypatch):
     profs = t.aggregate(snap)
     assert t._hash_disabled
     assert sum(p.total() for p in profs) == snap.total_samples()
+    _assert_fallback_gauge(device_telemetry, "loc_dedup")

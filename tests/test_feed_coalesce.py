@@ -437,7 +437,24 @@ def _cols(snap, lo, hi):
             snap.kernel_len[lo:hi], snap.stacks[lo:hi], snap.counts[lo:hi])
 
 
-def test_feeder_tracks_hash_and_coalesce_seconds():
+@pytest.fixture(params=[0.0, 1.0], ids=["no-period", "1s-period"])
+def window_period(request):
+    """The stale-timing-pop cases run twice: bare, and under a 1 s
+    window period with the device flight recorder installed — the
+    sub-second-window regime the SLO layer judges
+    (docs/observability.md "device flight recorder"). The pop contract
+    must hold identically; the 1 s arm additionally exercises the
+    telemetry record path under the feeder's dispatch cadence."""
+    from parca_agent_tpu.runtime import device_telemetry as dtel_mod
+
+    period = request.param
+    if period:
+        dtel_mod.install(dtel_mod.DeviceTelemetry(period_s=period))
+    yield period
+    dtel_mod.install(None)
+
+
+def test_feeder_tracks_hash_and_coalesce_seconds(window_period):
     from parca_agent_tpu.profiler.streaming import StreamingWindowFeeder
 
     dup = _dup(_snap(seed=47, rows=256, pids=4), dup=3)
@@ -459,7 +476,8 @@ def test_feeder_tracks_hash_and_coalesce_seconds():
     assert feeder.stats["last_window_coalesce_s"] == 0.0
 
 
-def test_fallback_window_hash_timings_do_not_leak_into_next_stream():
+def test_fallback_window_hash_timings_do_not_leak_into_next_stream(
+        window_period):
     """A one-shot window_counts between streamed windows leaves its own
     feed_hash/feed_coalesce in the shared aggregator's timings; the next
     streamed window's first drain must discard them, not absorb them."""
@@ -480,7 +498,7 @@ def test_fallback_window_hash_timings_do_not_leak_into_next_stream():
     assert feeder.stats["last_window_coalesce_s"] < sentinel
 
 
-def test_streamed_window_records_hash_and_coalesce_spans():
+def test_streamed_window_records_hash_and_coalesce_spans(window_period):
     """The profiler's trace spans mirror the feeder's per-window split
     (the same lockstep contract as feed/feed_dispatch_overlap)."""
     from parca_agent_tpu.profiler.cpu import CPUProfiler
@@ -510,6 +528,7 @@ def test_streamed_window_records_hash_and_coalesce_spans():
     rec = FlightRecorder()
     prof = CPUProfiler(source=Src(3), aggregator=agg, profile_writer=W(),
                        fast_encode=True, streaming_feeder=feeder,
+                       duration_s=window_period,
                        trace_recorder=rec)
     for _ in range(3):
         assert prof.run_iteration()
@@ -520,6 +539,16 @@ def test_streamed_window_records_hash_and_coalesce_spans():
     pct = rec.percentiles()
     assert pct["feed_hash"]["count"] >= 1
     assert pct["feed_coalesce"]["count"] >= 1
+    if window_period:
+        # The 1 s-period arm: every streamed window rolled into the
+        # window-SLO layer, well under budget.
+        from parca_agent_tpu.runtime import device_telemetry as dtel_mod
+
+        tel = dtel_mod.get()
+        assert tel.window_stats["windows_total"] == 3
+        assert tel.window_stats["windows_over_budget_total"] == 0
+        assert 0.0 < tel.window_stats["budget_used_last"] < 1.0
+        assert tel.stats["record_errors"] == 0
 
 
 # -- partition vectorization + one-shot kernel fold ---------------------------
