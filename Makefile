@@ -96,12 +96,17 @@ bench-sinks:
 bench-scale:
 	JAX_PLATFORMS=cpu PARCA_BENCH_SCALE_CHILD=1 $(PYTHON) bench.py
 
-# Ingest-wall A/B (docs/perf.md "ingest wall"): the scale sweep's pid
-# tiers fed through raw / coalesced / coalesced+native-hash arms —
+# Ingest-wall A/B (docs/perf.md "ingest wall" + "feed endgame"): the
+# scale sweep's pid tiers fed through raw / coalesced / coalesced+
+# native-hash / carry+fold arms over a dup>=2 stationary stream —
 # per-window feed seconds reduced >= 3x at the top tier, coalesced+
-# native saturation < 50% of the window, zero windows lost, counts +
-# pprof identity held across every arm. Host-bound, so it pins the
-# cpu backend. PARCA_BENCH_FEED_TIERS overrides for quick runs.
+# native saturation < 50% of the window, carry+fold saturation < 1%
+# (steady-state windows dispatch ~nothing: the cross-drain carry cache
+# absorbs repeat stacks host-side and flushes once at close), zero
+# windows lost, counts + pprof identity held across every arm, and the
+# drain-cache hit rate + carry counters land in the artifact.
+# Host-bound, so it pins the cpu backend. PARCA_BENCH_FEED_TIERS
+# overrides for quick runs.
 bench-feed:
 	JAX_PLATFORMS=cpu PARCA_BENCH_FEED_CHILD=1 $(PYTHON) bench.py
 

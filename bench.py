@@ -2477,23 +2477,42 @@ def _scale_sweep() -> dict:
 
 def _feed_wall() -> dict:
     """`make bench-feed`: the ingest-wall A/B (docs/perf.md "ingest
-    wall"). PR 13's scale_sweep measured per-window feed work growing
-    O(rows) — 1.1 s -> 11.3 s from 50k to 500k pids — which saturates
-    the 10 s window and caps the pid axis. This phase runs the sweep's
-    pid tiers through three arms of the SAME window stream:
+    wall" + "feed endgame"). PR 13's scale_sweep measured per-window
+    feed work growing O(rows) — 1.1 s -> 11.3 s from 50k to 500k pids —
+    which saturates the 10 s window and caps the pid axis. This phase
+    runs the sweep's pid tiers through four arms of the SAME window
+    stream:
 
       raw                coalesce off, numpy lane-matrix hash (the
                          PR 13 baseline feed path, re-measured)
-      coalesced          the (stack, weight) fold, numpy hash
+      coalesced          the (stack, weight) fold, numpy hash — the
+                         fold now runs BEFORE the hash in this arm
+                         (feed() orders on native_hash_available), so
+                         only fold representatives pay the O(lanes)
+                         numpy hash
       coalesced+native   the fold + the C batch row-hash kernel
+                         (native walks live depth only, so it hashes
+                         every row first and folds by hash triple)
+      carry+fold         the full feed endgame: hashes arrive WITH the
+                         drain (capture-side carry — the sampler stamps
+                         h1/h2/h3 per deduped record at drain time, so
+                         they are precomputed outside the timed region
+                         here) plus the cross-drain carry cache: stacks
+                         dispatched in an earlier window accumulate
+                         host-side and flush once at close, so a
+                         stationary workload's steady-state feeds
+                         dispatch (nearly) nothing
 
     Each tier's window carries cross-thread stack repetition (every
     unique (pid, stack) appears on PARCA_BENCH_FEED_DUP tids — the
-    shape a multi-threaded service hands the drain), so the fold has
-    real duplicates to collapse. Bars (the error field, scored via
-    _finalize_result): per-window feed seconds at the top tier reduced
-    >= 3x vs the raw arm, feed_saturation_pct < 50 for the coalesced+
-    native arm, zero windows lost, and identity held across all arms —
+    shape a multi-threaded service hands the drain) and the SAME
+    snapshot repeats every window (dup >= 2 stationary repetition), so
+    the fold has real duplicates to collapse and the carry cache has
+    real cross-window repeats to absorb. Bars (the error field, scored
+    via _finalize_result): per-window feed seconds at the top tier
+    reduced >= 3x vs the raw arm, feed_saturation_pct < 50 for the
+    coalesced+native arm and < 1 for the carry+fold arm at the top
+    tier, zero windows lost, and identity held across all arms —
     counts byte-equal at every tier, pprof sha256 at the lowest tier
     (encoding 500k pids of statics would measure the statics wall, not
     the feed)."""
@@ -2531,10 +2550,10 @@ def _feed_wall() -> dict:
             stacks=stacks_u[idx], mappings=MappingTable.empty(),
         )
 
-    arms = ("raw", "coalesced", "coalesced+native")
+    arms = ("raw", "coalesced", "coalesced+native", "carry+fold")
 
     def _arm_env(arm):
-        if arm == "coalesced+native":
+        if arm in ("coalesced+native", "carry+fold"):
             os.environ.pop("PARCA_NO_NATIVE_HASH", None)
         else:
             os.environ["PARCA_NO_NATIVE_HASH"] = "1"
@@ -2558,15 +2577,22 @@ def _feed_wall() -> dict:
                 cap = 1 << max(16, (4 * n_u - 1).bit_length())
                 agg = DictAggregator(
                     capacity=cap, id_cap=1 << (2 * n_u - 1).bit_length(),
-                    overflow="sketch", coalesce=arm != "raw")
+                    overflow="sketch", coalesce=arm != "raw",
+                    carry=arm == "carry+fold")
                 enc = WindowEncoder(agg) if pids_n == pprof_tier else None
+                # Capture-side hash carry: in production the sampler's
+                # dedup drain stamps the triple once per unique record
+                # (v1h), off the feed path — modeled here by hashing
+                # outside the timed region.
+                carry_hashes = agg.hash_rows(snap) \
+                    if arm == "carry+fold" else None
                 feeds = []
                 counts_sha[arm] = []
                 pprof_sha[arm] = []
                 for w in range(windows):
                     agg.discard_open_window()
                     t0 = time.perf_counter()
-                    agg.feed(snap)
+                    agg.feed(snap, hashes=carry_hashes)
                     feeds.append(time.perf_counter() - t0)
                     counts = agg.close_window(copy=True)
                     if int(np.asarray(counts).sum()) != want_mass:
@@ -2585,8 +2611,22 @@ def _feed_wall() -> dict:
                     "feed_first_ms": round(feeds[0] * 1e3, 2),
                     "feed_steady_ms": round(_median_ms(feeds[1:]), 2),
                     "feed_saturation_pct": round(
-                        _median_ms(feeds[1:]) / 10_000 * 100, 1),
+                        _median_ms(feeds[1:]) / 10_000 * 100, 2),
                 }
+                if arm == "carry+fold":
+                    # Drain-cache accounting: hit_rate is the fraction
+                    # of post-fold dispatch rows absorbed host-side; on
+                    # this stationary stream every steady-state row
+                    # should hit (first window admits, the rest carry).
+                    s = agg.stats
+                    rows_in = int(s.get("carry_rows_in", 0))
+                    tier[arm]["carry"] = {
+                        k: int(s.get("carry_" + k, 0))
+                        for k in ("rows_in", "hits", "mass", "admitted",
+                                  "entries", "flushes", "fallbacks")}
+                    tier[arm]["carry"]["hit_rate"] = round(
+                        int(s.get("carry_hits", 0)) / rows_in, 4) \
+                        if rows_in else 0.0
                 del agg, enc
             if any(counts_sha[a] != counts_sha["raw"] for a in arms):
                 counts_identical = False
@@ -2607,9 +2647,13 @@ def _feed_wall() -> dict:
     by_pids = {t["pids"]: t for t in phase["tiers"]}
     reduction = by_pids[top]["feed_reduction_vs_raw"]
     top_sat = by_pids[top]["coalesced+native"]["feed_saturation_pct"]
+    carry_top = by_pids[top]["carry+fold"]
+    carry_sat = carry_top["feed_saturation_pct"]
     phase["windows_lost"] = windows_lost
     phase["feed_reduction_vs_raw"] = reduction
     phase["feed_saturation_pct"] = top_sat
+    phase["feed_saturation_pct_carry"] = carry_sat
+    phase["carry_hit_rate"] = carry_top["carry"]["hit_rate"]
     phase["bytes_identical"] = bool(counts_identical and pprof_identical)
     if windows_lost:
         phase["error"] = f"{windows_lost} windows lost mass"
@@ -2623,6 +2667,13 @@ def _feed_wall() -> dict:
     elif top_sat >= 50:
         phase["error"] = (f"coalesced+native feed saturation "
                           f"{top_sat}% at the top tier (bar: < 50)")
+    elif carry_sat >= 1:
+        phase["error"] = (f"carry+fold feed saturation {carry_sat}% "
+                          "at the top tier (bar: < 1)")
+    elif carry_top["carry"]["fallbacks"]:
+        phase["error"] = ("carry cache fell back "
+                          f"{carry_top['carry']['fallbacks']}x "
+                          "on a fault-free run")
     return phase
 
 

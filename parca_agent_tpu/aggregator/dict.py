@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import numpy as np
 
@@ -47,7 +48,7 @@ from parca_agent_tpu.capture.formats import (
     WindowSnapshot,
     fold_rows_first_seen,
 )
-from parca_agent_tpu.ops.hashing import row_hash_np
+from parca_agent_tpu.ops.hashing import native_hash_available, row_hash_np
 from parca_agent_tpu.runtime import device_telemetry as dtel
 from parca_agent_tpu.utils import faults
 
@@ -309,14 +310,18 @@ class _CloseHandle:
     arrays the retry loop can re-pack any number of times while the next
     window's feeds land in the flipped twin."""
 
-    __slots__ = ("acc", "touch", "fed_total", "pending", "n_ids",
-                 "n_fetch", "width", "n_over_buf", "delta_blks", "out_dev")
+    __slots__ = ("acc", "touch", "fed_total", "pending", "pending_vec",
+                 "n_ids", "n_fetch", "width", "n_over_buf", "delta_blks",
+                 "out_dev")
 
     def __init__(self):
         self.acc = None
         self.touch = None
         self.fed_total = 0
         self.pending = []
+        # The carry cache's window flush: (sids int64, counts int64)
+        # arrays, applied once at collect (same lifecycle as pending).
+        self.pending_vec = None
         self.n_ids = 0
         self.n_fetch = 0
         self.width = 0
@@ -390,7 +395,8 @@ class DictAggregator:
                  rotate_min_age: int = 6,
                  delta_fetch: bool = True,
                  probe_backend: str = "lax",
-                 coalesce: bool = True):
+                 coalesce: bool = True,
+                 carry: bool = False):
         from parca_agent_tpu.ops.sketch import CountMinSpec, HLLSpec
 
         if capacity & (capacity - 1):
@@ -422,6 +428,34 @@ class DictAggregator:
         # feed.coalesce) is counted and degrades to the uncoalesced
         # path, never a lost feed.
         self._coalesce = coalesce
+        # Cross-drain carry cache (docs/perf.md "feed endgame"): an
+        # h1-sorted host map key -> (stack id, accumulated weight). A
+        # stack's FIRST dispatch admits its key; every later drain that
+        # sees the key folds its mass host-side instead of shipping a
+        # dispatch row, and the close flushes the accumulated (sid,
+        # weight) pairs alongside the pending corrections. With a
+        # stationary population the steady-state window dispatches ~no
+        # rows at all — one dispatch row per unique NEW stack, ever.
+        # Weights are zeroed at every window boundary (close flush,
+        # discard), so corrections never leak across windows; the
+        # key->sid entries persist until rotation remaps the id space.
+        # Bounded by construction: at most one entry per live stack id.
+        self._carry = carry
+        self._carry_h1 = np.zeros(0, np.uint32)  # sorted, unique
+        self._carry_h2 = np.zeros(0, np.uint32)
+        self._carry_h3 = np.zeros(0, np.uint32)
+        self._carry_sid = np.zeros(0, np.int64)
+        self._carry_w = np.zeros(0, np.int64)
+        # Prefix-bucket index over _carry_h1: starts[p] .. starts[p+1]
+        # bounds the entries whose top carry_shift-complement bits equal
+        # p. Binary search over a million needles is cache-hostile
+        # (measured 115 ms of a 145 ms steady feed at the 500k-pid
+        # tier); the direct-indexed bucket walk is ~O(1) probes per
+        # needle at <=0.5 load. Rebuilt only at admission.
+        self._carry_shift = 32
+        self._carry_starts = np.zeros(2, np.int64)
+        self._carry_open_mass = 0   # mass carried for the open window
+        self._carry_disabled = False  # fault: match off until boundary
         self._cm_spec = cm_spec or CountMinSpec()
         self._hll_spec = HLLSpec()
         self._cm = None                  # lazy [depth, width] int64
@@ -501,8 +535,10 @@ class DictAggregator:
         # handles without a host sync; the miss check settles at the NEXT
         # feed (or at close), by which time the kernel has long finished —
         # the capture thread stops paying the probe kernel's latency.
-        # (handle, packed, snapshot, lo, h1, h2, h3, rep, weights) —
-        # rep/weights are the coalesced feed's fold (None uncoalesced).
+        # (handle, packed, snapshot, rows_map, w64, h1, h2, h3) — all
+        # DISPATCH-row aligned: rows_map maps each dispatched row to its
+        # representative snapshot row, w64 is its (possibly folded)
+        # mass, h1/h2/h3 its identity triple.
         self._miss_inflight = None
         # Dispatched-but-uncollected close (close_dispatch/close_collect).
         self._close_handle: _CloseHandle | None = None
@@ -581,6 +617,14 @@ class DictAggregator:
         self._fed_total = 0
         self._pending = []
         self._needs_reset = True
+        # Carried mass of the aborted window must not leak into the
+        # next one's flush; the cache itself (key -> sid) stays warm.
+        self._carry_disabled = False
+        if self._carry_open_mass:
+            self._carry_w[:] = 0
+            self._carry_open_mass = 0
+            self.stats["carry_discards"] = \
+                self.stats.get("carry_discards", 0) + 1
 
     # -- registry identity (statics snapshot support) ------------------------
 
@@ -668,7 +712,12 @@ class DictAggregator:
     # palint: device-state: _dev, _acc, _touch, _acc_spare, _touch_spare
     def feed(self, snapshot: WindowSnapshot, hashes=None,
              lo: int = 0, hi: int | None = None) -> None:
-        """Accumulate snapshot rows [lo, hi) into the open window."""
+        """Accumulate snapshot rows [lo, hi) into the open window.
+
+        ``hashes`` is the capture-carried identity triple (h1, h2, h3)
+        over ALL snapshot rows — the sampler's dedup drain computes it
+        once per unique record (docs/perf.md "feed endgame"); None
+        self-hashes here."""
         import time as _time
 
         import jax.numpy as jnp
@@ -677,6 +726,7 @@ class DictAggregator:
         n = hi - lo
         if n <= 0:
             return
+        self.timings.pop("feed_carry", None)
         # Settle the PREVIOUS feed's deferred miss check first: (a) its
         # pack buffer may be reused below and the device may alias host
         # numpy zero-copy, (b) miss resolution (= id assignment) must
@@ -685,63 +735,120 @@ class DictAggregator:
         # kernel-latency stall the old inline sync paid.
         self._settle_misses()
         chunk_total = int(snapshot.counts[lo:hi].sum())
-        if self._fed_total + chunk_total >= 2**31:
+        if self._fed_total + self._carry_open_mass + chunk_total >= 2**31:
             raise ValueError("window sample total exceeds int32")
         if self._needs_reset:
             # First feed of a new window: the boundary where cold-id
             # rotation is safe (nothing live indexes stack ids).
             self._maybe_rotate()
+        # Dispatch-row state: `rows_map` maps each dispatch row back to
+        # a representative snapshot row (absolute index) for miss
+        # resolution; `w64` carries its exact (possibly folded) mass.
+        # Carry matches and coalesce folds below filter/fold both in
+        # lockstep with the hash lanes.
+        w64 = np.asarray(snapshot.counts[lo:hi], np.int64)
         if hashes is not None:
             h1, h2, h3 = hashes
+            h1c = np.asarray(h1[lo:hi], np.uint32)
+            h2c = np.asarray(h2[lo:hi], np.uint32)
+            h3c = np.asarray(h3[lo:hi], np.uint32)
+            h2c = self._route_hashes(h1c, h2c, h3c, snapshot.pids[lo:hi])
+            # Carry BEFORE the fold: carried rows are known stacks whose
+            # mass accumulates host-side; only the remainder pays the
+            # fold and the dispatch (rows_map is built lazily — the
+            # fully-carried steady-state feed never materializes it).
+            keep = self._carry_match(h1c, h2c, h3c, w64)
+            if keep is not None:
+                h1c, h2c, h3c = h1c[keep], h2c[keep], h3c[keep]
+                w64 = w64[keep]
+                rows_map = np.flatnonzero(keep) + lo
+            else:
+                rows_map = np.arange(lo, hi, dtype=np.int64)
+            if self._coalesce and len(h1c) > 1:
+                h1c, h2c, h3c, w64, rows_map = self._coalesce_triples(
+                    h1c, h2c, h3c, w64, rows_map)
         else:
-            t0 = _time.perf_counter()
-            h1, h2, h3 = self.hash_rows(snapshot)
-            self.timings["feed_hash"] = _time.perf_counter() - t0
-        # Coalesce the batch to (stack, weight) pairs: dispatch rows
-        # track uniques, not samples (the accumulate kernel already
-        # takes counts, so summed weights ride for free). `rep` maps
-        # each dispatched row back to a representative snapshot row for
-        # miss resolution; `weights` carries the folded mass the miss
-        # corrections must use instead of the representative's count.
-        h1c, h2c, h3c = h1[lo:hi], h2[lo:hi], h3[lo:hi]
-        counts_c = snapshot.counts[lo:hi].astype(np.uint32)
-        rep = None
-        weights = None
-        if self._coalesce and n > 1:
-            t0 = _time.perf_counter()
-            try:
-                faults.inject("feed.coalesce")
-                key = np.empty((n, 3), np.uint32)
-                key[:, 0] = h1c
-                key[:, 1] = h2c
-                key[:, 2] = h3c
-                folded = fold_rows_first_seen(
-                    key.view(np.dtype((np.void, 12))).ravel(),
-                    snapshot.counts[lo:hi])
-                if folded is not None:
-                    rep, _inv, w64 = folded
-                    h1c, h2c, h3c = h1c[rep], h2c[rep], h3c[rep]
-                    counts_c = w64.astype(np.uint32)
-                    weights = w64
-                self.stats["coalesce_rows_in"] = \
-                    self.stats.get("coalesce_rows_in", 0) + n
-                self.stats["coalesce_rows_out"] = \
-                    self.stats.get("coalesce_rows_out", 0) \
-                    + (len(rep) if rep is not None else n)
-            except Exception as e:  # noqa: BLE001 - counted fallback
-                # Fail-open to the uncoalesced path: the feed must never
-                # be lost to the optimization riding it. Locals are only
-                # rebound on success above, so the raw slices are intact.
-                rep = None
-                weights = None
-                self.stats["coalesce_fallbacks"] = \
-                    self.stats.get("coalesce_fallbacks", 0) + 1
-                from parca_agent_tpu.utils.log import get_logger
+            rows_map = np.arange(lo, hi, dtype=np.int64)
+            # Self-hash. The work order depends on the hash backend: the
+            # native kernel walks only live depth, so hashing every row
+            # then folding by triple is cheapest; the numpy lane-matrix
+            # fallback pays O(rows x lanes) per hashed row, so there the
+            # fold runs FIRST — on raw row content, the same equality
+            # the triple keys (modulo hash collisions the aggregator
+            # already tolerates) — and only representatives get hashed.
+            fold_first = self._coalesce and n > 1 and (
+                bool(os.environ.get("PARCA_NO_NATIVE_HASH"))
+                or not native_hash_available())
+            rep = None
+            if fold_first:
+                t0 = _time.perf_counter()
+                try:
+                    faults.inject("feed.coalesce")
+                    sl = slice(lo, hi)
+                    depth = (np.asarray(snapshot.user_len[sl], np.int64)
+                             + np.asarray(snapshot.kernel_len[sl],
+                                          np.int64))
+                    md = int(depth.max(initial=0))
+                    rec = np.empty((n, 3 + md), np.uint64)
+                    rec[:, 0] = np.asarray(snapshot.pids[sl],
+                                           np.int64).view(np.uint64)
+                    rec[:, 1] = np.asarray(snapshot.user_len[sl],
+                                           np.uint64)
+                    rec[:, 2] = np.asarray(snapshot.kernel_len[sl],
+                                           np.uint64)
+                    if md:
+                        rec[:, 3:] = snapshot.stacks[sl, :md]
+                    folded = fold_rows_first_seen(
+                        rec.view(np.dtype(
+                            (np.void, (3 + md) * 8))).ravel(), w64)
+                    if folded is not None:
+                        rep, _inv, fw = folded
+                        w64 = fw
+                        rows_map = rows_map[rep]
+                    self.stats["coalesce_rows_in"] = \
+                        self.stats.get("coalesce_rows_in", 0) + n
+                    self.stats["coalesce_rows_out"] = \
+                        self.stats.get("coalesce_rows_out", 0) \
+                        + len(rows_map)
+                except Exception as e:  # noqa: BLE001 - counted fallback
+                    # Fail-open to the unfolded batch (locals are only
+                    # rebound on success above, so rows_map/w64 are
+                    # intact); the triple fold is NOT retried — one fold
+                    # attempt per feed, like the hash-then-fold order.
+                    rep = None
+                    self.stats["coalesce_fallbacks"] = \
+                        self.stats.get("coalesce_fallbacks", 0) + 1
+                    from parca_agent_tpu.utils.log import get_logger
 
-                get_logger("aggregator.dict").warn(
-                    "feed coalesce failed; dispatching the uncoalesced "
-                    "batch", error=repr(e)[:200])
-            self.timings["feed_coalesce"] = _time.perf_counter() - t0
+                    get_logger("aggregator.dict").warn(
+                        "feed coalesce failed; dispatching the "
+                        "uncoalesced batch", error=repr(e)[:200])
+                self.timings["feed_coalesce"] = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            if rep is None:
+                h1, h2, h3 = self.hash_rows(snapshot)
+                h1c, h2c, h3c = h1[lo:hi], h2[lo:hi], h3[lo:hi]
+            else:
+                h1c, h2c, h3c = row_hash_np(
+                    np.ascontiguousarray(snapshot.stacks[rows_map]),
+                    snapshot.pids[rows_map],
+                    snapshot.user_len[rows_map],
+                    snapshot.kernel_len[rows_map], n_hashes=3)
+                h2c = self._route_hashes(h1c, h2c, h3c,
+                                         snapshot.pids[rows_map])
+            self.timings["feed_hash"] = _time.perf_counter() - t0
+            if not fold_first and self._coalesce and n > 1:
+                h1c, h2c, h3c, w64, rows_map = self._coalesce_triples(
+                    h1c, h2c, h3c, w64, rows_map)
+            keep = self._carry_match(h1c, h2c, h3c, w64)
+            if keep is not None:
+                h1c, h2c, h3c = h1c[keep], h2c[keep], h3c[keep]
+                w64, rows_map = w64[keep], rows_map[keep]
+        if not len(h1c):
+            # The whole batch carried: nothing to dispatch — its mass
+            # rides the carry cache to the close flush.
+            return
+        counts_c = w64.astype(np.uint32)
         nd = len(h1c)
         t0 = _time.perf_counter()
         counts_c, corrections = self._prefilter_unreachable(
@@ -779,49 +886,270 @@ class DictAggregator:
         self._pending.extend(corrections)
         # _fed_total means "mass in the DEVICE accumulator" (the close
         # gate and width prediction read it); host-settled corrections
-        # are not part of it.
-        self._fed_total += chunk_total - sum(c for _, c in corrections)
+        # and carried mass are not part of it.
+        self._fed_total += int(w64.sum()) - sum(c for _, c in corrections)
         # Dispatch-only cost: the miss sync that used to ride here (and
         # block the capture thread for the kernel's full latency) is
         # deferred to the next feed / the close, where the kernel has
         # already completed and the sync is ~free — the feed's device
         # work OVERLAPS capture instead of stalling it.
         self.timings["feed_dispatch"] = _time.perf_counter() - t0
-        self._miss_inflight = (handle, packed, snapshot, lo, h1, h2, h3,
-                               rep, weights)
+        self._miss_inflight = (handle, packed, snapshot, rows_map, w64,
+                               h1c, h2c, h3c)
 
     # palint: sync-ok — THE deferred sync boundary: by the next feed (or
     # the close) the kernel has completed, so this is a completion
     # check, not the kernel-latency stall the old inline sync paid.
     def _settle_misses(self) -> None:
         """Settle the deferred miss check of the last dispatched feed:
-        sync the miss count, and resolve any misses (insert new stacks,
-        queue host-side count corrections). Runs at the next feed and at
-        close — always before the window's counts are read."""
+        sync the miss count, resolve any misses (insert new stacks,
+        queue host-side count corrections), then admit the dispatched
+        keys into the carry cache so later drains fold against them.
+        Runs at the next feed and at close — always before the window's
+        counts are read."""
         import time as _time
 
         inflight, self._miss_inflight = self._miss_inflight, None
         if inflight is None:
             return
-        handle, _packed, snapshot, lo, h1, h2, h3, rep, weights = inflight
+        handle, _packed, snapshot, rows_map, w64, h1d, h2d, h3d = inflight
         t0 = _time.perf_counter()
         miss_rel = self._settle_dispatch(handle)
         self.timings["feed_settle"] = _time.perf_counter() - t0
         if len(miss_rel):
             t0 = _time.perf_counter()
-            if rep is not None:
-                # Coalesced dispatch: miss indices address the folded
-                # rows — translate to representative snapshot rows, and
-                # carry the FOLDED weights (the representative's own
-                # count would drop its duplicates' mass).
-                rows = rep[miss_rel] + lo
-                wts = weights[miss_rel]
-            else:
-                rows = miss_rel.astype(np.int64) + lo
-                wts = None
-            self._pending.extend(
-                self._resolve_misses(snapshot, rows, h1, h2, h3, wts))
+            # Miss indices address dispatch rows: rows_map translates
+            # back to representative snapshot rows, and the dispatch-
+            # row-aligned hash lanes and FOLDED weights (a
+            # representative's own count would drop its duplicates'
+            # mass) ride the inflight tuple with them.
+            self._pending.extend(self._resolve_misses(
+                snapshot, rows_map[miss_rel], h1d[miss_rel],
+                h2d[miss_rel], h3d[miss_rel], w64[miss_rel]))
             self.timings["feed_miss"] = _time.perf_counter() - t0
+        if self._carry and not self._carry_disabled:
+            t0 = _time.perf_counter()
+            self._carry_admit(h1d, h2d, h3d)
+            self.timings["feed_carry"] = \
+                self.timings.get("feed_carry", 0.0) \
+                + (_time.perf_counter() - t0)
+
+    # -- cross-drain carry cache (docs/perf.md "feed endgame") ---------------
+
+    def _route_hashes(self, h1, h2, h3, pids):
+        """Rewrite hook for identity triples computed OUTSIDE hash_rows
+        (capture-carried hashes, post-fold representative hashing):
+        subclasses that re-route identity lanes (the sharded
+        aggregator's per-pid h2 shard residue) apply the same rewrite
+        here so carried and self-hashed triples agree bit-for-bit.
+        Returns the (possibly rewritten) h2 lane."""
+        return h2
+
+    def _coalesce_triples(self, h1c, h2c, h3c, w64, rows_map):
+        """Coalesce dispatch rows to (stack, weight) pairs on the
+        (h1, h2, h3) identity: dispatch rows track uniques, not samples
+        (the accumulate kernel takes counts, so summed weights ride for
+        free). Exact by the same 96-bit identity the whole aggregator
+        keys on, and first-occurrence ordered so miss order — and
+        therefore id assignment and pprof bytes — is bit-identical to
+        the unfolded stream. A fold failure (chaos site feed.coalesce)
+        is counted and degrades to the unfolded batch, never a lost
+        feed."""
+        import time as _time
+
+        n = len(h1c)
+        t0 = _time.perf_counter()
+        try:
+            faults.inject("feed.coalesce")
+            key = np.empty((n, 3), np.uint32)
+            key[:, 0] = h1c
+            key[:, 1] = h2c
+            key[:, 2] = h3c
+            folded = fold_rows_first_seen(
+                key.view(np.dtype((np.void, 12))).ravel(), w64)
+            if folded is not None:
+                rep, _inv, fw = folded
+                h1c, h2c, h3c = h1c[rep], h2c[rep], h3c[rep]
+                w64 = fw
+                rows_map = rows_map[rep]
+            self.stats["coalesce_rows_in"] = \
+                self.stats.get("coalesce_rows_in", 0) + n
+            self.stats["coalesce_rows_out"] = \
+                self.stats.get("coalesce_rows_out", 0) + len(h1c)
+        except Exception as e:  # noqa: BLE001 - counted fallback
+            # Fail-open to the unfolded batch: the feed must never be
+            # lost to the optimization riding it. Locals are only
+            # rebound on success above, so the input rows are intact.
+            self.stats["coalesce_fallbacks"] = \
+                self.stats.get("coalesce_fallbacks", 0) + 1
+            from parca_agent_tpu.utils.log import get_logger
+
+            get_logger("aggregator.dict").warn(
+                "feed coalesce failed; dispatching the uncoalesced "
+                "batch", error=repr(e)[:200])
+        self.timings["feed_coalesce"] = _time.perf_counter() - t0
+        return h1c, h2c, h3c, w64, rows_map
+
+    def _carry_match(self, h1c, h2c, h3c, w64):
+        """Cross-drain fold: batch rows whose keys already sit in the
+        carry cache accumulate their mass host-side instead of shipping
+        dispatch rows — a stack pays ONE dispatch on first sight and
+        rides the cache for every later drain (and, population
+        stationary, every later window). Returns the keep mask (False =
+        carried) or None when nothing matched. A match failure (chaos
+        site feed.carry) is counted and disables matching until the
+        window boundary: the batch dispatches whole and mass already
+        accumulated still flushes at close, so counts stay exact."""
+        if not self._carry or self._carry_disabled \
+                or not len(self._carry_h1) or not len(h1c):
+            return None
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            faults.inject("feed.carry")
+            # Bucket walk: each needle scans its prefix bucket (sorted,
+            # h1-unique, load <= 0.5 so almost always one probe) with
+            # the still-unresolved subset shrinking per pass.
+            pref = (h1c >> self._carry_shift).astype(np.int64)
+            cur = self._carry_starts[pref]
+            end = self._carry_starts[pref + 1]
+            pos = np.full(len(h1c), -1, np.int64)
+            act = np.flatnonzero(cur < end)
+            while len(act):
+                c = cur[act]
+                cand = self._carry_h1[c]
+                eq = cand == h1c[act]
+                pos[act[eq]] = c[eq]
+                # Bucket entries are ascending: passing the needle's
+                # value ends its scan (absent key).
+                more = ~eq & (cand < h1c[act])
+                act = act[more]
+                cur[act] += 1
+                act = act[cur[act] < end[act]]
+            hit = pos >= 0
+            if hit.all():
+                # Steady-state fast path (every row a candidate): the
+                # verify runs without sub-index gathers.
+                hit = ((self._carry_h2[pos] == h2c)
+                       & (self._carry_h3[pos] == h3c))
+            elif hit.any():
+                sub = np.flatnonzero(hit)
+                e = pos[sub]
+                ok = ((self._carry_h2[e] == h2c[sub])
+                      & (self._carry_h3[e] == h3c[sub]))
+                hit[sub[~ok]] = False  # h1 collision: not cached
+            self.stats["carry_rows_in"] = \
+                self.stats.get("carry_rows_in", 0) + len(h1c)
+            n_hit = int(hit.sum())
+            if not n_hit:
+                return None
+            if n_hit == len(hit):
+                eidx, w = pos, w64
+            else:
+                eidx, w = pos[hit], w64[hit]
+            # float64 bincount is exact below 2^53 total mass (same
+            # guard as fold_rows_first_seen; window mass < 2^31).
+            add = np.bincount(eidx, weights=w.astype(np.float64),
+                              minlength=len(self._carry_w)).astype(
+                                  np.int64)
+            carried = int(w.sum())
+            self.stats["carry_hits"] = \
+                self.stats.get("carry_hits", 0) + n_hit
+            self.stats["carry_mass"] = \
+                self.stats.get("carry_mass", 0) + carried
+            # Mutate LAST: an exception past this point could not be
+            # failed open without double-counting the batch.
+            self._carry_w += add
+            self._carry_open_mass += carried
+            return ~hit
+        except Exception as e:  # noqa: BLE001 - counted fallback
+            self._carry_disabled = True
+            self.stats["carry_fallbacks"] = \
+                self.stats.get("carry_fallbacks", 0) + 1
+            from parca_agent_tpu.utils.log import get_logger
+
+            get_logger("aggregator.dict").warn(
+                "feed carry match failed; dispatching per drain for "
+                "the rest of the window", error=repr(e)[:200])
+            return None
+        finally:
+            self.timings["feed_carry"] = \
+                self.timings.get("feed_carry", 0.0) \
+                + (_time.perf_counter() - t0)
+
+    def _carry_admit(self, h1d, h2d, h3d) -> None:
+        """Admit a dispatch's keys into the carry cache. h1 stays
+        UNIQUE in the cache (sorted membership tests stay one
+        searchsorted; a same-h1 different-key collision simply keeps
+        dispatching per drain — exact either way), and only keys with
+        live ids in the host mirror are admitted: sketch-absorbed
+        overflow keys must keep riding the sketch, never an exact
+        host-side flush. Runs after miss resolution, so a drain's new
+        inserts are admittable immediately."""
+        if not len(h1d):
+            return
+        u, ui = np.unique(h1d, return_index=True)
+        if len(self._carry_h1):
+            pos = np.minimum(np.searchsorted(self._carry_h1, u),
+                             len(self._carry_h1) - 1)
+            fresh = self._carry_h1[pos] != u
+            u, ui = u[fresh], ui[fresh]
+        if not len(u):
+            return
+        h1n = np.ascontiguousarray(h1d[ui], np.uint32)
+        h2n = np.ascontiguousarray(h2d[ui], np.uint32)
+        h3n = np.ascontiguousarray(h3d[ui], np.uint32)
+        ids, _stop, overrun = self._classify_keys_vec(h1n, h2n, h3n)
+        if overrun:
+            return  # wrapped probe chain: skip admission this drain
+        ok = ids >= 0
+        n_new = int(ok.sum())
+        if not n_new:
+            return
+        nh1 = np.concatenate([self._carry_h1, h1n[ok]])
+        order = np.argsort(nh1, kind="stable")
+        self._carry_h1 = nh1[order]
+        self._carry_h2 = np.concatenate([self._carry_h2, h2n[ok]])[order]
+        self._carry_h3 = np.concatenate([self._carry_h3, h3n[ok]])[order]
+        self._carry_sid = np.concatenate(
+            [self._carry_sid, ids[ok]])[order]
+        self._carry_w = np.concatenate(
+            [self._carry_w, np.zeros(n_new, np.int64)])[order]
+        self._carry_reindex()
+        self.stats["carry_admitted"] = \
+            self.stats.get("carry_admitted", 0) + n_new
+        self.stats["carry_entries"] = len(self._carry_h1)
+
+    def _carry_reindex(self) -> None:
+        """Rebuild the prefix-bucket index (~2 buckets per entry,
+        clamped to [2^12, 2^22])."""
+        n = len(self._carry_h1)
+        k = max(12, min(22, int(2 * n - 1).bit_length()))
+        self._carry_shift = 32 - k
+        counts = np.bincount(
+            (self._carry_h1 >> self._carry_shift).astype(np.int64),
+            minlength=1 << k)
+        starts = np.zeros((1 << k) + 1, np.int64)
+        np.cumsum(counts, out=starts[1:])
+        self._carry_starts = starts
+
+    def _carry_take(self):
+        """Flush the open window's carried mass: (sids, counts) int64
+        arrays, or (None, None) when nothing was carried. Zeroes the
+        accumulated weights and re-arms matching — this is the window
+        boundary, and carried corrections must never leak across it."""
+        self._carry_disabled = False
+        if not self._carry_open_mass:
+            return None, None
+        nz = np.flatnonzero(self._carry_w)
+        sids = self._carry_sid[nz].copy()
+        cnts = self._carry_w[nz].copy()
+        self._carry_w[nz] = 0
+        self._carry_open_mass = 0
+        self.stats["carry_flushes"] = \
+            self.stats.get("carry_flushes", 0) + 1
+        return sids, cnts
 
     def _new_acc(self):
         """Fresh device accumulator (subclasses shard it)."""
@@ -1024,7 +1352,9 @@ class DictAggregator:
         if self._close_handle is not None:
             raise RuntimeError("previous close not collected")
         self._settle_misses()
-        if self._fed_total == 0 and not self._pending:
+        carry_sids, carry_cnts = self._carry_take()
+        if self._fed_total == 0 and not self._pending \
+                and carry_sids is None:
             self.stats["windows"] += 1
             # No flip, no fetch: drop the previous close's timings so a
             # trace-span reader can't attribute them to this window.
@@ -1033,6 +1363,8 @@ class DictAggregator:
             return None
         h = _CloseHandle()
         h.pending, self._pending = self._pending, []
+        if carry_sids is not None:
+            h.pending_vec = (carry_sids, carry_cnts)
         h.fed_total = self._fed_total
         h.n_ids = self._next_id
         if self._acc is not None and self._fed_total:
@@ -1256,6 +1588,13 @@ class DictAggregator:
             cnts = np.array([p[1] for p in h.pending], np.int64)
             np.add.at(counts, sids, cnts)
             h.pending = []
+        if h.pending_vec is not None:
+            # The carry flush: vectorized (sid, count) corrections from
+            # the cross-drain cache, applied exactly once per handle
+            # (retries above re-pack the device buffers, never this).
+            sids, cnts = h.pending_vec
+            np.add.at(counts, sids, cnts)
+            h.pending_vec = None
         self.stats["windows"] += 1
         out = counts[: h.n_ids]
         self._last_seen[np.flatnonzero(out)] = self.stats["windows"]
@@ -1360,6 +1699,16 @@ class DictAggregator:
         self._ids[:] = -1
         self._unreachable = {}  # chains change wholesale with the rebuild
         self._unreach_h1 = None
+        # The carry cache maps keys to the OLD id space: drop it
+        # wholesale (live keys re-admit at their next dispatch; the
+        # accumulated weights are zero at a boundary).
+        self._carry_h1 = np.zeros(0, np.uint32)
+        self._carry_h2 = np.zeros(0, np.uint32)
+        self._carry_h3 = np.zeros(0, np.uint32)
+        self._carry_sid = np.zeros(0, np.int64)
+        self._carry_w = np.zeros(0, np.int64)
+        self._carry_shift = 32
+        self._carry_starts = np.zeros(2, np.int64)
         for key, sid in self._key_to_id.items():
             nid = int(old_to_new[sid])
             if nid < 0:
@@ -1408,9 +1757,11 @@ class DictAggregator:
                         ) -> list[tuple[int, int]]:
         """Absorb device-miss rows: insert genuinely new stacks (host mirror
         + device table), and return (stack_id, count) corrections the caller
-        must add to the window's counts. ``weights`` overrides
-        ``snapshot.counts[rows]`` (the coalesced feed's folded masses);
-        large clean batches take the vectorized plan-then-commit path,
+        must add to the window's counts. ``h1/h2/h3`` are MISS-ALIGNED
+        lanes (one per ``rows`` entry — the feed keeps its dispatch-row
+        hashes and passes the missed subset); ``weights`` overrides
+        ``snapshot.counts[rows]`` (the coalesced feed's folded masses).
+        Large clean batches take the vectorized plan-then-commit path,
         every degradation case falls back to this scalar loop."""
         rows = np.asarray(rows, np.int64)
         wts = (np.asarray(weights, np.int64) if weights is not None
@@ -1447,7 +1798,7 @@ class DictAggregator:
         n_new = 0
         seen_batch: set = set()
         for pos, r in enumerate(map(int, rows)):
-            key = (int(h1[r]), int(h2[r]), int(h3[r]))
+            key = (int(h1[pos]), int(h2[pos]), int(h3[pos]))
             existing = self._key_to_id.get(key)
             if existing is None and key not in seen_batch:
                 seen_batch.add(key)
@@ -1648,9 +1999,9 @@ class DictAggregator:
         Returns the pending corrections, or None to fall back (nothing
         mutated). Id assignment stays in first-occurrence row order, so
         output bytes are identical to the scalar path's."""
-        h1m = np.ascontiguousarray(h1[rows], np.uint32)
-        h2m = np.ascontiguousarray(h2[rows], np.uint32)
-        h3m = np.ascontiguousarray(h3[rows], np.uint32)
+        h1m = np.ascontiguousarray(h1, np.uint32)
+        h2m = np.ascontiguousarray(h2, np.uint32)
+        h3m = np.ascontiguousarray(h3, np.uint32)
         key = np.empty((len(rows), 3), np.uint32)
         key[:, 0] = h1m
         key[:, 1] = h2m

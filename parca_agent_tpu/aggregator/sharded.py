@@ -212,10 +212,17 @@ class ShardedDictAggregator(DictAggregator):
 
     def hash_rows(self, snapshot):
         h1, h2, h3 = super().hash_rows(snapshot)
+        return h1, self._route_hashes(h1, h2, h3, snapshot.pids), h3
+
+    def _route_hashes(self, h1, h2, h3, pids):
+        # The single source of the h2 shard-residue rewrite: hash_rows
+        # above and every externally-computed triple (capture-carried
+        # hashes, the feed's post-fold representative hashing) route
+        # through here, so identity stays bit-identical regardless of
+        # where the triple was computed.
         if self._shard_of_pid is not None:
-            h2 = route_h2(h2, snapshot.pids, self._shard_of_pid,
-                          self._n_shards)
-        return h1, h2, h3
+            return route_h2(h2, pids, self._shard_of_pid, self._n_shards)
+        return h2
 
     # -- host-mirror placement: probe within the key's home sub-table -------
 

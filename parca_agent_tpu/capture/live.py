@@ -113,6 +113,34 @@ def load_native():
         u8p, ctypes.c_long, i32p, i32p, i32p, i32p,
         ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_long, ctypes.c_long]
+    # v1h (hash-carrying dedup drain) entry points — guarded so a stale
+    # pre-carry .so still loads; the sampler then simply runs hashless
+    # (PerfEventSampler checks hash_carry before using them).
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    try:
+        lib.pa_sampler_set_hash.restype = ctypes.c_int
+        lib.pa_sampler_set_hash.argtypes = [
+            ctypes.c_void_p, u32p, ctypes.c_long, u32p, ctypes.c_int,
+            ctypes.c_long]
+        lib.pa_sampler_drain_dedup2.restype = ctypes.c_long
+        lib.pa_sampler_drain_dedup2.argtypes = [ctypes.c_void_p, u8p,
+                                                ctypes.c_long]
+        lib.pa_decode_v1h_count.restype = ctypes.c_long
+        lib.pa_decode_v1h_count.argtypes = [u8p, ctypes.c_long,
+                                            ctypes.c_long]
+        lib.pa_decode_v1h.restype = ctypes.c_long
+        lib.pa_decode_v1h.argtypes = [
+            u8p, ctypes.c_long, i32p, i32p, i32p, i32p,
+            ctypes.POINTER(ctypes.c_int64), u32p, u32p, u32p,
+            u64p, ctypes.c_long, ctypes.c_long]
+        lib.pa_stack_hash.restype = ctypes.c_int
+        lib.pa_stack_hash.argtypes = [
+            u64p, ctypes.c_long, u64p, ctypes.c_long, ctypes.c_uint32,
+            u32p, ctypes.c_long, u32p, ctypes.c_long, ctypes.c_long,
+            u32p]
+    except AttributeError:
+        pass
     return lib
 
 
@@ -218,6 +246,45 @@ def decode_records_columnar_v1d(lib, buf, nbytes: int) -> tuple:
     return pids, tids, ulen, klen, stacks, counts
 
 
+def decode_records_columnar_v1h(lib, buf, nbytes: int) -> tuple:
+    """Native one-pass v1h decode (hash-carrying dedup-drain records,
+    32-byte header with count + h1/h2/h3) into columnar arrays. Returns
+    (pids, tids, ulen, klen, stacks, counts, h1, h2, h3) with user frames
+    first per row; the hash triple is bit-identical to row_hash_np over
+    the decoded row (the drain computed it with the same installed
+    coefficient tables)."""
+    if isinstance(buf, (bytes, bytearray)):
+        buf = (ctypes.c_uint8 * nbytes).from_buffer_copy(buf[:nbytes])
+    p = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+    n = int(lib.pa_decode_v1h_count(p, nbytes, STACK_SLOTS))
+    pids = np.zeros(n, np.int32)
+    tids = np.zeros(n, np.int32)
+    ulen = np.zeros(n, np.int32)
+    klen = np.zeros(n, np.int32)
+    counts = np.zeros(n, np.int64)
+    h1 = np.zeros(n, np.uint32)
+    h2 = np.zeros(n, np.uint32)
+    h3 = np.zeros(n, np.uint32)
+    stacks = np.zeros((n, STACK_SLOTS), np.uint64)
+    if n:
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        got = int(lib.pa_decode_v1h(
+            p, nbytes,
+            pids.ctypes.data_as(i32p),
+            tids.ctypes.data_as(i32p),
+            ulen.ctypes.data_as(i32p),
+            klen.ctypes.data_as(i32p),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            h1.ctypes.data_as(u32p),
+            h2.ctypes.data_as(u32p),
+            h3.ctypes.data_as(u32p),
+            stacks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            STACK_SLOTS, n))
+        assert got == n, (got, n)
+    return pids, tids, ulen, klen, stacks, counts, h1, h2, h3
+
+
 def mapping_table_for_pids(maps_cache, objs_cache, pids,
                            quarantine=None) -> MappingTable:
     """MappingTable for a set of pids via the shared caches; pids that
@@ -274,17 +341,25 @@ def mapping_table_for_pids(maps_cache, objs_cache, pids,
 def columns_to_snapshot(
     pids, tids, ulen, klen, stacks,
     mappings: MappingTable, period_ns: int, window_ns: int,
-    weights=None,
+    weights=None, hashes=None,
 ) -> WindowSnapshot:
     """Dedup identical (pid, tid, stack) rows into counted rows (the role
     the BPF stack_counts map plays in the reference). Columnar input from
     the native decoder or from records_to_snapshot's packing. `weights`
     carries per-row pre-aggregated counts (the native dedup drain emits
     them); rows still merge here — drain passes and table overflows leave
-    best-effort duplicates — with counts summed."""
+    best-effort duplicates — with counts summed.
+
+    `hashes` is an optional capture-carried (h1, h2, h3) uint32 triple
+    aligned with the input rows (the v1h drain). When given, the return
+    is (snapshot, (h1, h2, h3)) with the triple gathered onto the
+    snapshot's deduped rows — exact, because dedup-equal rows hash to
+    equal triples (the hash is a function of pid/ulen/klen/stack only)."""
     pids = np.asarray(pids, np.int32)
     if weights is not None:
         weights = np.asarray(weights, np.int64)
+    if hashes is not None:
+        hashes = tuple(np.asarray(h, np.uint32) for h in hashes)
     if len(pids) and int(pids.min()) < 0:
         # perf delivers unattributable/idle-context samples as pid -1;
         # they carry no process to profile, and downstream the uint32
@@ -297,9 +372,11 @@ def columns_to_snapshot(
         stacks = np.asarray(stacks)[keep]
         if weights is not None:
             weights = weights[keep]
+        if hashes is not None:
+            hashes = tuple(h[keep] for h in hashes)
     n = len(pids)
     if n == 0:
-        return WindowSnapshot(
+        snap = WindowSnapshot(
             pids=np.zeros(0, np.int32), tids=np.zeros(0, np.int32),
             counts=np.zeros(0, np.int64), user_len=np.zeros(0, np.int32),
             kernel_len=np.zeros(0, np.int32),
@@ -307,6 +384,9 @@ def columns_to_snapshot(
             mappings=mappings, period_ns=period_ns, window_ns=window_ns,
             time_ns=time.time_ns(),
         )
+        if hashes is not None:
+            return snap, tuple(np.zeros(0, np.uint32) for _ in range(3))
+        return snap
     # Vectorized row dedup (same byte-view trick as CPUAggregator),
     # comparing only up to the window's deepest stack: slots past it are
     # zero in every row, so the result is identical and the sort compares
@@ -338,12 +418,15 @@ def columns_to_snapshot(
         else:
             counts = np.zeros(len(first), np.int64)
             np.add.at(counts, inverse, weights.astype(np.int64))
-    return WindowSnapshot(
+    snap = WindowSnapshot(
         pids=pids[first], tids=tids[first], counts=counts,
         user_len=ulen[first], kernel_len=klen[first], stacks=stacks[first],
         mappings=mappings, period_ns=period_ns, window_ns=window_ns,
         time_ns=time.time_ns(),
     )
+    if hashes is not None:
+        return snap, tuple(h[first] for h in hashes)
+    return snap
 
 
 def records_to_snapshot(
@@ -651,6 +734,30 @@ class PerfEventSampler:
             self._handle = None
             raise SamplerUnavailable("failed to enable perf events")
         self.n_cpus = self._lib.pa_sampler_n_cpus(self._handle)
+        # Capture-side hash carry (docs/perf.md "feed endgame"): install
+        # the Python-seeded multilinear coefficient tables so the dedup
+        # drain can stamp each unique record with its h1/h2/h3 triple
+        # while the frames are hot in cache. FP mode only (the DWARF
+        # walker rewrites user chains after the drain, invalidating any
+        # drain-time hash). PARCA_NO_CAPTURE_HASH=1 pins the hashless
+        # v1d drain — the build-less fallback stays exact either way.
+        self.hash_carry = False
+        if not capture_stack \
+                and not os.environ.get("PARCA_NO_CAPTURE_HASH"):
+            try:
+                from parca_agent_tpu.ops.hashing import hash_params
+
+                coefs, biases = hash_params(3, STACK_SLOTS)
+                u32p = ctypes.POINTER(ctypes.c_uint32)
+                ok = self._lib.pa_sampler_set_hash(
+                    self._handle, coefs.ctypes.data_as(u32p),
+                    coefs.shape[1], biases.ctypes.data_as(u32p), 3,
+                    STACK_SLOTS)
+                self.hash_carry = ok == 0
+            except AttributeError:
+                # Stale pre-carry .so: run hashless; the feeder hashes
+                # host-side exactly as before.
+                pass
         self._tables = UnwindTableCache(
             self._maps, comm_regex=dwarf_comm_regex) if capture_stack \
             else None
@@ -702,12 +809,16 @@ class PerfEventSampler:
             return int(self._lib.pa_sampler_dedup_overflow(self._handle))
         return self._final_counters[3]
 
-    def _drain_passes(self, consume, dedup: bool = False) -> None:
+    def _drain_passes(self, consume, dedup: bool = False,
+                      hashed: bool = False) -> None:
         """Lossless drain: loops while the native side reports records
         left behind for lack of buffer space, handing each pass's
         (buffer, n_bytes) to `consume` before the buffer is reused."""
-        drain = (self._lib.pa_sampler_drain_dedup if dedup
-                 else self._lib.pa_sampler_drain)
+        if hashed:
+            drain = self._lib.pa_sampler_drain_dedup2
+        else:
+            drain = (self._lib.pa_sampler_drain_dedup if dedup
+                     else self._lib.pa_sampler_drain)
         for _ in range(64):  # safety bound; one pass is the norm
             before = self.truncated_drains
             n = drain(
@@ -729,8 +840,23 @@ class PerfEventSampler:
         """Lossless DEDUP drain with the native columnar decoder applied
         per pass, straight off the reusable drain buffer (no bytes copy).
         The native side pre-aggregates repeats to (row, count) so Python
-        decodes ~unique rows (the reference's in-kernel envelope)."""
+        decodes ~unique rows (the reference's in-kernel envelope). With
+        hash carry installed the chunks additionally tail the h1/h2/h3
+        triple (9 columns instead of 6); a refused v1h drain permanently
+        falls back to the hashless v1d drain mid-session."""
         cols = []
+        if self.hash_carry:
+            try:
+                self._drain_passes(
+                    lambda buf, n: cols.append(
+                        decode_records_columnar_v1h(self._lib, buf, n)),
+                    hashed=True)
+                return cols
+            except SamplerUnavailable:
+                _log.warn("v1h drain refused; disabling capture-side "
+                          "hash carry for this sampler")
+                self.hash_carry = False
+                cols = []
         self._drain_passes(
             lambda buf, n: cols.append(
                 decode_records_columnar_v1d(self._lib, buf, n)),

@@ -384,6 +384,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "+ --fast-encode); window close is then one packed "
                         "fetch. Device trouble self-disables back to the "
                         "one-shot path; exactness is checked per window")
+    p.add_argument("--no-feed-carry", action="store_true",
+                   help="disable the cross-drain carry cache (streaming "
+                        "windows fold repeat stacks host-side and flush "
+                        "their mass once at close; exact either way). "
+                        "PARCA_NO_CAPTURE_HASH=1 separately pins the "
+                        "capture sampler's drain-time hash carry off")
     p.add_argument("--fleet-coordinator", default="",
                    help="host:port of fleet node 0; joining forms the "
                         "cross-host device mesh (jax.distributed) and "
@@ -644,7 +650,8 @@ def run(argv=None) -> int:
                      devices=n_dev, shards=n_shards)
         aggregator = ShardedDictAggregator(
             capacity=args.aggregator_capacity, overflow="sketch",
-            mesh=fleet_mesh(n_shards))
+            mesh=fleet_mesh(n_shards),
+            carry=args.streaming_window and not args.no_feed_carry)
         fallback = CPUAggregator()
     elif args.aggregator in ("dict", "dict+cm"):
         from parca_agent_tpu.aggregator.dict import DictAggregator
@@ -652,9 +659,12 @@ def run(argv=None) -> int:
         # Both modes share the implementation; "dict" fails fast at
         # capacity (fixed-population benchmarking), "dict+cm" degrades to
         # the count-min sideband + cold-stack rotation (always-on agents).
+        # The cross-drain carry cache only pays off when a window spans
+        # several feeds, i.e. under --streaming-window.
         aggregator = DictAggregator(
             capacity=args.aggregator_capacity,
-            overflow="sketch" if args.aggregator == "dict+cm" else "raise")
+            overflow="sketch" if args.aggregator == "dict+cm" else "raise",
+            carry=args.streaming_window and not args.no_feed_carry)
         fallback = CPUAggregator()
     else:
         aggregator = CPUAggregator()
@@ -1231,6 +1241,11 @@ def run(argv=None) -> int:
             out["parca_agent_capture_dedup_hits_total"] = source.dedup_hits
             out["parca_agent_capture_dedup_overflow_total"] = \
                 source.dedup_overflow
+        if hasattr(source, "hash_carry"):
+            # Capture-side hash carry: 1 when the native sampler stamps
+            # h1/h2/h3 on each deduped record at drain time (v1h), 0 when
+            # pinned off (PARCA_NO_CAPTURE_HASH) or unavailable.
+            out["parca_agent_capture_hash_carry"] = int(source.hash_carry)
         from parca_agent_tpu.web import escape_label_value
 
         labels = ",".join(f'{k}="{escape_label_value(v)}"'

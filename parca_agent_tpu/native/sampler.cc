@@ -96,6 +96,17 @@ struct Sampler {
   bool dd_dirty = false;    // previous dedup drain registered entries
   uint64_t dedup_hits = 0;  // records merged instead of re-emitted
   uint64_t dd_overflow = 0; // probe budget exhausted: emitted unregistered
+  // Capture-side row-hash tables (pa_sampler_set_hash): Python owns the
+  // seeded multilinear coefficients (ops/hashing.py _COEFS/_BIASES) and
+  // installs contiguous copies here, so the hashes the dedup drain carries
+  // are bit-identical to row_hash_np. n_fam == 0 means not installed and
+  // pa_sampler_drain_dedup2 refuses (-3): the caller falls back to the
+  // hashless v1d drain.
+  uint32_t* hash_coefs = nullptr;  // [n_fam][stride]
+  uint32_t hash_biases[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  long hash_stride = 0;
+  long hash_slots = 0;
+  int hash_nfam = 0;
 };
 
 // FNV-1a over the sample identity (pid, tid, nk, nu, frames).
@@ -105,6 +116,52 @@ uint64_t fnv1a(const uint8_t* p, size_t n, uint64_t h = 1469598103934665603ull) 
     h *= 1099511628211ull;
   }
   return h;
+}
+
+// fmix32 finalizer (murmur3-style) — the C twin of ops/hashing.py mix32.
+inline uint32_t fmix32(uint32_t x) {
+  x ^= x >> 16;
+  x *= 0x85EBCA6Bu;
+  x ^= x >> 13;
+  x *= 0xC2B2AE35u;
+  x ^= x >> 16;
+  return x;
+}
+
+// Multilinear row hash over a split (kernel, user) frame pair — the
+// drain-side twin of ops/hashing.py row_hash_np over the snapshot row the
+// record decodes to. The snapshot row stores USER frames first then the
+// kernel tail, zero-padded to `slots`; the lane matrix is
+// [hi(slots) | lo(slots) | pid | user_len | kernel_len] with family
+// coefficients c: zero pad lanes contribute c*0, so walking only the live
+// depth is bit-identical to the full lane matrix (same argument the
+// vecenc.cc pa_row_hash kernel rests on). Frames may not exceed `slots`
+// (caller-guarded: kMaxFrames < STACK_SLOTS).
+inline void stack_hash_mix(const uint64_t* kframes, uint32_t nk,
+                           const uint64_t* uframes, uint32_t nu,
+                           uint32_t pid, const uint32_t* coefs, long stride,
+                           const uint32_t* biases, int n_fam, long slots,
+                           uint32_t* out) {
+  for (int f = 0; f < n_fam; f++) {
+    const uint32_t* c = coefs + f * stride;
+    uint32_t acc = 0;
+    // User frames occupy row slots [0, nu); kernel tail [nu, nu + nk).
+    for (uint32_t s = 0; s < nu; s++) {
+      uint64_t fr = uframes[s];
+      if (!fr) continue;
+      acc += c[s] * static_cast<uint32_t>(fr >> 32)
+           + c[slots + s] * static_cast<uint32_t>(fr);
+    }
+    for (uint32_t s = 0; s < nk; s++) {
+      uint64_t fr = kframes[s];
+      if (!fr) continue;
+      acc += c[nu + s] * static_cast<uint32_t>(fr >> 32)
+           + c[slots + nu + s] * static_cast<uint32_t>(fr);
+    }
+    acc += c[2 * slots] * pid + c[2 * slots + 1] * nu
+         + c[2 * slots + 2] * nk;
+    out[f] = fmix32(acc + biases[f]);
+  }
 }
 
 long perf_open(int cpu, int freq, bool capture_stack, uint32_t dump_bytes) {
@@ -139,6 +196,7 @@ void destroy_partial(Sampler* s, int opened) {
   delete[] s->scratch;
   delete[] s->dd_hash;
   delete[] s->dd_off;
+  delete[] s->hash_coefs;
   delete s;
 }
 
@@ -543,6 +601,182 @@ long pa_decode_v1d(const uint8_t* buf, long len,
   return n;
 }
 
+// ---- v1h drain: dedup + capture-side hash carry -----------------------
+//
+// The hash half of the feed endgame (docs/perf.md "feed endgame"): the
+// h1/h2/h3 triple the dictionary aggregator keys on is computed HERE,
+// while the record's frames are hot in cache from the dedup memcmp,
+// instead of re-walking every row on the Python side one drain later.
+// The mix is the same multilinear family as ops/hashing.py (Python
+// installs its seeded coefficient tables via pa_sampler_set_hash — the C
+// side cannot regenerate numpy-seeded streams), so the carried triple is
+// bit-identical to row_hash_np over the decoded snapshot row.
+//
+// v1h record:
+//   u32 pid | u32 tid | u32 n_kernel | u32 n_user | u32 count
+//   | u32 h1 | u32 h2 | u32 h3
+//   | u64 frames[n_kernel + n_user]                      (kernel first)
+
+// Install per-family hash constants. coefs is [n_fam][stride] row-major
+// with stride >= 2*slots + 3 lanes; biases is [n_fam]. Returns 0, or -1
+// on invalid arguments. slots is the snapshot row width (STACK_SLOTS) —
+// the lane layout splits at it, so drain records and snapshot rows hash
+// identically only when it matches the Python side's constant.
+int pa_sampler_set_hash(Sampler* s, const uint32_t* coefs, long stride,
+                        const uint32_t* biases, int n_fam, long slots) {
+  if (!s || !coefs || !biases || n_fam < 1 || n_fam > 8 ||
+      slots < (long)kMaxFrames || stride < 2 * slots + 3)
+    return -1;
+  delete[] s->hash_coefs;
+  s->hash_coefs = new uint32_t[(size_t)n_fam * stride];
+  std::memcpy(s->hash_coefs, coefs, (size_t)n_fam * stride * 4);
+  std::memcpy(s->hash_biases, biases, (size_t)n_fam * 4);
+  s->hash_stride = stride;
+  s->hash_slots = slots;
+  s->hash_nfam = n_fam;
+  return 0;
+}
+
+// Like pa_sampler_drain_dedup, emitting v1h records with the hash triple
+// computed once per UNIQUE record (dedup hits only bump the count — the
+// hash depends on neither count nor the probe order). Returns -3 when no
+// hash tables are installed (caller falls back to the v1d drain).
+long pa_sampler_drain_dedup2(Sampler* s, uint8_t* out, long cap) {
+  if (!s || !out || cap < 0) return -1;
+  if (s->capture_stack) return -2;
+  if (s->hash_nfam < 3) return -3;
+  if (!s->dd_hash) {
+    s->dd_cap = 1 << 16;
+    s->dd_hash = new uint64_t[s->dd_cap]();
+    s->dd_off = new long[s->dd_cap];
+  }
+  if (s->dd_dirty) {
+    std::memset(s->dd_hash, 0, s->dd_cap * sizeof(uint64_t));
+    s->dd_dirty = false;
+  }
+  const uint64_t dd_mask = s->dd_cap - 1;
+
+  long written = 0;
+  walk_rings(s, [&](uint32_t pid, uint32_t tid, uint64_t* kframes,
+                    uint32_t nk, uint64_t* uframes, uint32_t nu,
+                    uint8_t*, uint8_t*) -> bool {
+    uint32_t nf = nk + nu;
+    if (nf == 0 || nf > kMaxFrames) return true;  // consumed, not emitted
+    uint32_t ident[4] = {pid, tid, nk, nu};
+    uint64_t h = fnv1a(reinterpret_cast<uint8_t*>(ident), 16);
+    h = fnv1a(reinterpret_cast<uint8_t*>(kframes), 8ul * nk, h);
+    h = fnv1a(reinterpret_cast<uint8_t*>(uframes), 8ul * nu, h);
+    if (h == 0) h = 1;
+    uint64_t idx = h & dd_mask;
+    for (int probes = 0; probes < 64; probes++) {
+      if (s->dd_hash[idx] == 0) break;
+      if (s->dd_hash[idx] == h) {
+        uint8_t* o = out + s->dd_off[idx];
+        if (std::memcmp(o, ident, 16) == 0 &&
+            std::memcmp(o + 32, kframes, 8ul * nk) == 0 &&
+            std::memcmp(o + 32 + 8ul * nk, uframes, 8ul * nu) == 0) {
+          uint32_t c;
+          std::memcpy(&c, o + 16, 4);
+          c++;
+          std::memcpy(o + 16, &c, 4);
+          s->dedup_hits++;
+          return true;
+        }
+      }
+      idx = (idx + 1) & dd_mask;
+    }
+    long need = 32 + 8l * nf;
+    if (written + need > cap) return false;
+    uint32_t triple[3];
+    stack_hash_mix(kframes, nk, uframes, nu, pid, s->hash_coefs,
+                   s->hash_stride, s->hash_biases, 3, s->hash_slots,
+                   triple);
+    uint8_t* o = out + written;
+    uint32_t one = 1;
+    std::memcpy(o, ident, 16);
+    std::memcpy(o + 16, &one, 4);
+    std::memcpy(o + 20, triple, 12);
+    std::memcpy(o + 32, kframes, 8l * nk);
+    std::memcpy(o + 32 + 8l * nk, uframes, 8l * nu);
+    if (s->dd_hash[idx] == 0) {
+      s->dd_hash[idx] = h;
+      s->dd_off[idx] = written;
+      s->dd_dirty = true;
+    } else {
+      s->dd_overflow++;
+    }
+    written += need;
+    return true;
+  });
+  return written;
+}
+
+// v1h decoders: the v1d pair plus the carried hash triple.
+long pa_decode_v1h_count(const uint8_t* buf, long len, long stack_slots) {
+  long pos = 0, n = 0;
+  while (pos + 32 <= len) {
+    uint32_t hdr[4];
+    std::memcpy(hdr, buf + pos, 16);
+    long nf = (long)hdr[2] + (long)hdr[3];
+    if (nf > (long)kMaxFrames || nf > stack_slots ||
+        pos + 32 + 8 * nf > len)
+      break;
+    pos += 32 + 8 * nf;
+    n++;
+  }
+  return n;
+}
+
+long pa_decode_v1h(const uint8_t* buf, long len,
+                   int32_t* pids, int32_t* tids,
+                   int32_t* ulen, int32_t* klen, int64_t* counts,
+                   uint32_t* h1, uint32_t* h2, uint32_t* h3,
+                   uint64_t* stacks, long stack_slots, long cap) {
+  long pos = 0, n = 0;
+  while (pos + 32 <= len && n < cap) {
+    uint32_t hdr[8];
+    std::memcpy(hdr, buf + pos, 32);
+    long nk = hdr[2], nu = hdr[3];
+    long nf = nk + nu;
+    if (nf > (long)kMaxFrames || nf > stack_slots ||
+        pos + 32 + 8 * nf > len)
+      break;
+    pids[n] = (int32_t)hdr[0];
+    tids[n] = (int32_t)hdr[1];
+    klen[n] = (int32_t)nk;
+    ulen[n] = (int32_t)nu;
+    counts[n] = (int64_t)hdr[4];
+    h1[n] = hdr[5];
+    h2[n] = hdr[6];
+    h3[n] = hdr[7];
+    uint64_t* row = stacks + n * stack_slots;
+    std::memcpy(row, buf + pos + 32 + 8 * nk, 8 * nu);
+    std::memcpy(row + nu, buf + pos + 32, 8 * nk);
+    pos += 32 + 8 * nf;
+    n++;
+  }
+  return n;
+}
+
+// Direct hash entry (no Sampler, no perf privileges): the bit-identity
+// tests drive the SAME helper the dedup drain uses over arbitrary split
+// (kernel, user) frame pairs and compare against row_hash_np. Returns 0,
+// or -1 on invalid arguments.
+int pa_stack_hash(const uint64_t* kframes, long nk,
+                  const uint64_t* uframes, long nu, uint32_t pid,
+                  const uint32_t* coefs, long stride,
+                  const uint32_t* biases, long n_fam, long slots,
+                  uint32_t* out) {
+  if ((!kframes && nk > 0) || (!uframes && nu > 0) ||
+      !coefs || !biases || !out ||
+      nk < 0 || nu < 0 || n_fam < 1 || n_fam > 8 ||
+      nk + nu > slots || stride < 2 * slots + 3)
+    return -1;
+  stack_hash_mix(kframes, (uint32_t)nk, uframes, (uint32_t)nu, pid,
+                 coefs, stride, biases, (int)n_fam, slots, out);
+  return 0;
+}
+
 // ---- v1 drain decode: packed records -> columnar arrays ---------------
 // Per record: u32 pid, tid, nk, nu | (nk + nu) u64 frames, KERNEL first
 // (the drain writer above). Decoding in native code replaces two Python
@@ -607,6 +841,7 @@ void pa_sampler_destroy(Sampler* s) {
   delete[] s->scratch;
   delete[] s->dd_hash;
   delete[] s->dd_off;
+  delete[] s->hash_coefs;
   delete s;
 }
 

@@ -171,6 +171,30 @@ def _row_hash_native(stacks_u64, pids, user_len, kernel_len,
     return tuple(out)
 
 
+def native_hash_available() -> bool:
+    """Whether the native batch row-hash kernel is loadable. The feed
+    path orders its work on this: with the native kernel (walks only
+    live depth) it hashes every row then folds by triple; without it the
+    numpy lane-matrix fallback pays O(rows x lanes) per hash, so the
+    fold runs first and only representatives get hashed."""
+    return _load_native() is not None
+
+
+def hash_params(n_hashes: int, slots: int):
+    """Contiguous (coefs [n_hashes, 2*slots+3], biases [n_hashes]) slices
+    of the seeded multilinear family — what the capture sampler installs
+    via pa_sampler_set_hash so its drain-time h1/h2/h3 carry matches
+    row_hash_np bit-for-bit. The C side cannot regenerate numpy-seeded
+    streams; these tables are the single source of truth."""
+    if not 1 <= n_hashes <= N_FAMILIES:
+        raise ValueError(f"n_hashes out of range: {n_hashes}")
+    k = 2 * slots + 3
+    if k > _MAX_LANES:
+        raise ValueError(f"too many lanes to hash: {k} > {_MAX_LANES}")
+    return (np.ascontiguousarray(_COEFS[:n_hashes, :k]),
+            np.ascontiguousarray(_BIASES[:n_hashes]))
+
+
 def row_hash_np(stacks_u64: np.ndarray, pids, user_len, kernel_len,
                 n_hashes: int = 2):
     """Host-side (numpy) twin of the device row hash; used by sketches, the

@@ -855,6 +855,9 @@ class CPUProfiler:
             co = self._feeder.stats.get("last_window_coalesce_s", 0.0)
             if co:
                 tr.add_span("feed_coalesce", co)
+            ca = self._feeder.stats.get("last_window_carry_s", 0.0)
+            if ca:
+                tr.add_span("feed_carry", ca)
             if self._feeder.stats.get("last_window_streamed", 0):
                 tr.add_span("fetch",
                             self._feeder.stats.get("last_close_s", 0.0))

@@ -146,12 +146,19 @@ class StreamingWindowFeeder:
                       # like dispatch/settle: a stale value must never
                       # re-count into a later window's spans.
                       "last_window_hash_s": 0.0,
-                      "last_window_coalesce_s": 0.0}
+                      "last_window_coalesce_s": 0.0,
+                      # Feed endgame (docs/perf.md): capture-thread
+                      # seconds this window spent in the cross-drain
+                      # carry match (the h1-keyed cache that folds
+                      # repeated stacks host-side instead of
+                      # re-dispatching them every drain).
+                      "last_window_carry_s": 0.0}
         self._window_feed_s = 0.0
         self._window_dispatch_s = 0.0
         self._window_settle_s = 0.0
         self._window_hash_s = 0.0
         self._window_coalesce_s = 0.0
+        self._window_carry_s = 0.0
 
     def _discard_open_window(self) -> None:
         """Drop the aggregator's open-window state across buffer flips:
@@ -219,7 +226,10 @@ class StreamingWindowFeeder:
             return
         import numpy as np
 
-        pids, tids, ulen, klen, stacks, counts = cols
+        # v1d chunks are 6 columns; v1h chunks (capture-side hash carry)
+        # tail the drain-computed h1/h2/h3 triple.
+        pids, tids, ulen, klen, stacks, counts = cols[:6]
+        hashes = tuple(cols[6:9]) if len(cols) >= 9 else None
         if not len(pids):
             return
         t_feed0 = time.perf_counter()
@@ -237,7 +247,10 @@ class StreamingWindowFeeder:
                           error=repr(e))
                 return
             mini = columns_to_snapshot(pids, tids, ulen, klen, stacks,
-                                       table, 0, 0, weights=counts)
+                                       table, 0, 0, weights=counts,
+                                       hashes=hashes)
+            if hashes is not None:
+                mini, hashes = mini
             if len(mini) == 0:
                 return
             if self._fed_total == 0:
@@ -252,6 +265,7 @@ class StreamingWindowFeeder:
                     tim.pop("feed_settle", None)
                     tim.pop("feed_hash", None)
                     tim.pop("feed_coalesce", None)
+                    tim.pop("feed_carry", None)
             if self._fed_total == 0 \
                     and (getattr(self._agg, "_fed_total", 0)
                          or getattr(self._agg, "_pending", None)):
@@ -266,7 +280,7 @@ class StreamingWindowFeeder:
                 # ("_pending" survives an acc reset: the flag only zeroes
                 # the device accumulator).
                 self._discard_open_window()
-            if not self._feed_guarded(mini):
+            if not self._feed_guarded(mini, hashes):
                 # Do NOT try again this window: a wedged device would
                 # stall the capture loop on every subsequent drain.
                 # Re-probe only at a window boundary, after a
@@ -285,6 +299,7 @@ class StreamingWindowFeeder:
                 self._window_settle_s += tim.pop("feed_settle", 0.0)
                 self._window_hash_s += tim.pop("feed_hash", 0.0)
                 self._window_coalesce_s += tim.pop("feed_coalesce", 0.0)
+                self._window_carry_s += tim.pop("feed_carry", 0.0)
             self._fed_total += mini.total_samples()
             self.stats["drains_fed"] += 1
             if self._encoder is not None and self._prebuild_period:
@@ -304,7 +319,7 @@ class StreamingWindowFeeder:
             # flight recorder's feed span reads the per-window total).
             self._window_feed_s += time.perf_counter() - t_feed0
 
-    def _feed_guarded(self, mini: WindowSnapshot) -> bool:
+    def _feed_guarded(self, mini: WindowSnapshot, hashes=None) -> bool:
         """One feed under the shared abandonable guard (utils/
         bounded.py — palint bounded-call: this was the last hand-rolled
         copy of the spawn/join/abandon dance PR 5 unified)."""
@@ -314,7 +329,7 @@ class StreamingWindowFeeder:
             else self._timeout
         self._first_attempted = True
         status, out, done, _box = bounded_call(
-            lambda: self._agg.feed(mini), timeout,
+            lambda: self._agg.feed(mini, hashes=hashes), timeout,
             thread_name="stream-feed")
         if status == "hang":
             # Abandoned: the call may still be mutating the aggregator.
@@ -346,6 +361,8 @@ class StreamingWindowFeeder:
         self._window_hash_s = 0.0
         self.stats["last_window_coalesce_s"] = self._window_coalesce_s
         self._window_coalesce_s = 0.0
+        self.stats["last_window_carry_s"] = self._window_carry_s
+        self._window_carry_s = 0.0
         self.stats["last_window_streamed"] = 0
         if snapshot.period_ns:
             self._prebuild_period = snapshot.period_ns
@@ -398,5 +415,7 @@ class StreamingWindowFeeder:
             self.stats["last_window_hash_s"] += tim.pop("feed_hash", 0.0)
             self.stats["last_window_coalesce_s"] += tim.pop(
                 "feed_coalesce", 0.0)
+            self.stats["last_window_carry_s"] += tim.pop(
+                "feed_carry", 0.0)
         self._backoff = self._backoff_base  # healthy again: reset backoff
         return counts

@@ -64,6 +64,14 @@ layer the ship-path components consult at NAMED SITES:
                       is counted (coalesce_fallbacks) and the batch
                       dispatches UNCOALESCED — identical counts and
                       pprof bytes, never a lost feed or window
+    feed.carry        the cross-drain carry-cache match of one feed
+                      batch (aggregator/dict.py; docs/perf.md "feed
+                      endgame") — fail-open by contract: an injected
+                      fault is counted (carry_fallbacks) and the
+                      aggregator falls back to per-drain dispatch for
+                      the REST of the window (mass already carried
+                      still flushes at close) — identical counts and
+                      pprof bytes, never a lost feed or window
     device.telemetry  every device flight-recorder entry point
                       (runtime/device_telemetry.py record /
                       record_transfer / note_backend / tick_window) —
@@ -163,6 +171,7 @@ SITES = {
     "regression.baseline":
         "sentinel baseline save/adopt (runtime/regression.py)",
     "feed.coalesce": "feed-batch (stack, weight) fold (aggregator/dict.py)",
+    "feed.carry": "cross-drain carry-cache match (aggregator/dict.py)",
     "elf.read": "ElfFile construction (elf/reader.py)",
     "perfmap.parse": "JIT perf-map read+parse (symbolize/perfmap.py)",
     "maps.parse": "/proc/<pid>/maps parse (process/maps.py)",

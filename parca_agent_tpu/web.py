@@ -289,6 +289,23 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                  agg_stats.get("coalesce_rows_out", 0), lab)
             emit("parca_agent_feed_coalesce_fallbacks_total",
                  agg_stats.get("coalesce_fallbacks", 0), lab)
+            # Feed-endgame observability (docs/perf.md "feed endgame"):
+            # the cross-drain carry cache — rows tested vs rows folded
+            # host-side (hits/rows_in is the drain-cache hit rate), the
+            # carried sample mass, the cache population, and the
+            # counted fail-open fallbacks to per-drain dispatch.
+            emit("parca_agent_feed_carry_rows_in_total",
+                 agg_stats.get("carry_rows_in", 0), lab)
+            emit("parca_agent_feed_carry_hits_total",
+                 agg_stats.get("carry_hits", 0), lab)
+            emit("parca_agent_feed_carry_mass_total",
+                 agg_stats.get("carry_mass", 0), lab)
+            emit("parca_agent_feed_carry_entries",
+                 agg_stats.get("carry_entries", 0), lab)
+            emit("parca_agent_feed_carry_flushes_total",
+                 agg_stats.get("carry_flushes", 0), lab)
+            emit("parca_agent_feed_carry_fallbacks_total",
+                 agg_stats.get("carry_fallbacks", 0), lab)
             emit("parca_agent_feed_miss_vec_inserts_total",
                  agg_stats.get("miss_vec_inserts", 0), lab)
         feeder = getattr(p, "_feeder", None)
