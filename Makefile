@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale bench-feed bench-regress trace-smoke hotspot-smoke regress-smoke fixtures golden clean install
+.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale bench-feed bench-regress bench-zoo trace-smoke hotspot-smoke regress-smoke fixtures golden clean install
 
 all: native
 
@@ -37,8 +37,18 @@ test-live:
 # preflights it: the chaos-site checker is what keeps this suite's
 # coverage honest (every SITES entry exercised here, and vice versa),
 # so drift fails fast before any test runs.
-chaos: lint
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py tests/test_feed_coalesce.py tests/test_device_telemetry.py -q -m chaos
+chaos: lint bench-zoo
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py tests/test_feed_coalesce.py tests/test_device_telemetry.py tests/test_identity.py tests/test_zoo.py -q -m chaos
+
+# The workload-zoo matrix (docs/robustness.md "workload zoo"): >= 6
+# seeded hostile-world scenario rows — pid reuse under tenant
+# migration, perf-map churn, fork storms, deep stacks, kernel-heavy
+# mixes, tenant bursts — each driven through the REAL profiler window
+# loop and scored against per-scenario bars, plus the pid-reuse control
+# arm with the generation stamp pinned off (must REPRODUCE the
+# misattribution). Host-bound, reduced scale, one JSON line.
+bench-zoo:
+	JAX_PLATFORMS=cpu PARCA_BENCH_ZOO_CHILD=1 $(PYTHON) bench.py
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the

@@ -2779,6 +2779,61 @@ def _snap_main() -> None:
             _progress(f"snapshot pre-generation failed (non-fatal): {e!r}")
 
 
+def _zoo_main() -> None:
+    """`make bench-zoo`: the workload-zoo matrix (bench_zoo/), reduced
+    scale, seeded, one JSON line. Every scenario row drives the REAL
+    profiler window loop (runner.py) and must clear its bars — plus the
+    pid-reuse CONTROL arm, which pins the hardening off
+    (PARCA_NO_PID_GENERATION semantics) and must REPRODUCE the
+    cross-process misattribution, or the hardened arm's zero is
+    unfalsifiable. Host-bound by design (the zoo exercises the ingest/
+    identity/admission layers, not the device close)."""
+    from parca_agent_tpu.bench_zoo import run_scenario, run_zoo
+
+    seed = int(os.environ.get("PARCA_BENCH_ZOO_SEED", 1234))
+    scale = float(os.environ.get("PARCA_BENCH_ZOO_SCALE", 0.5))
+    phase: dict = {"seed": seed, "zoo_scale": scale}
+    try:
+        sweep = run_zoo(seed, scale=scale, hardened=True)
+        _progress(f"zoo sweep: {sweep['scenarios_passed']}"
+                  f"/{sweep['scenarios_total']} rows passed")
+        control = run_scenario("pid_reuse", seed, scale=scale,
+                               hardened=False)
+        _progress("control arm: misattributed_mass="
+                  f"{control.get('misattributed_mass')}")
+        phase["matrix"] = [
+            {k: r[k] for k in (
+                "scenario", "axis", "seed", "windows", "windows_lost",
+                "degraded_builds", "samples_fed", "samples_shipped",
+                "profiles_written", "close_latency_max_s", "bars",
+                "passed", "digest")}
+            for r in sweep["rows"]]
+        phase["schedule"] = sweep["schedule"]
+        phase["control_arm"] = {k: control[k] for k in (
+            "scenario", "hardened", "misattributed_mass", "bars",
+            "passed", "digest")}
+        failed = [r["scenario"] for r in sweep["rows"] if not r["passed"]]
+        if len(sweep["rows"]) < 6:
+            phase["error"] = (f"zoo ran only {len(sweep['rows'])} "
+                              "scenario rows (bar: >= 6)")
+        elif failed:
+            phase["error"] = "zoo bars failed: " + ", ".join(
+                f"{r['scenario']}:"
+                + ",".join(k for k, v in r["bars"].items() if not v)
+                for r in sweep["rows"] if not r["passed"])
+        elif not control["passed"]:
+            phase["error"] = ("pid-reuse control arm failed to reproduce "
+                              "misattribution with hardening pinned off")
+    except Exception as e:  # noqa: BLE001 - the line must still print
+        phase["error"] = repr(e)[:300]
+    import jax
+
+    phase["backend"] = jax.default_backend()
+    _finalize_result(phase, device_alive=True,
+                     require_full_scale=False, require_device=False)
+    print(json.dumps({"metric": "workload_zoo", **phase}))
+
+
 def _statics_main() -> None:
     """`make bench-statics`: the cold_restart drill alone, host-scale,
     one JSON line. Runs on whatever backend the env pins (the Make
@@ -2925,6 +2980,9 @@ def main() -> None:
 
         dtel.install(dtel.DeviceTelemetry())
 
+    if os.environ.get("PARCA_BENCH_ZOO_CHILD"):
+        _zoo_main()
+        return
     if os.environ.get("PARCA_BENCH_STATICS_CHILD"):
         _statics_main()
         return

@@ -462,6 +462,10 @@ class DictAggregator:
         self._over_hll = None            # lazy [m] int32 registers
         self._rotate_min_age = rotate_min_age
         self._rotate_pending = False
+        # Pids whose invalidate_pid arrived while a close/miss was in
+        # flight; drained at the next window boundary (same safety
+        # contract as rotation).
+        self._invalidate_pending: set[int] = set()
         # Per-id window number the id last had samples (eviction clock).
         self._last_seen = np.zeros(self._id_cap, np.int32)
         # Host mirror (source of truth).
@@ -642,10 +646,11 @@ class DictAggregator:
     @property
     def registry_epoch(self) -> int:
         """Rotation epoch of the id space: bumped whenever a cold-stack
-        rotation remaps stack ids wholesale. Mirrors consumers (the
-        window encoder, the statics snapshot header) key their validity
-        on this."""
-        return self.stats.get("rotations", 0)
+        rotation OR a pid-identity invalidation compaction remaps stack
+        ids wholesale. Mirrors consumers (the window encoder, the statics
+        snapshot header) key their validity on this."""
+        return (self.stats.get("rotations", 0)
+                + self.stats.get("invalidation_compactions", 0))
 
     def registry_digest(self, pid: int, n_mappings: int | None = None,
                         n_locs: int | None = None) -> bytes | None:
@@ -739,7 +744,9 @@ class DictAggregator:
             raise ValueError("window sample total exceeds int32")
         if self._needs_reset:
             # First feed of a new window: the boundary where cold-id
-            # rotation is safe (nothing live indexes stack ids).
+            # rotation (and any deferred pid-identity invalidation) is
+            # safe — nothing live indexes stack ids.
+            self._apply_pending_invalidations()
             self._maybe_rotate()
         # Dispatch-row state: `rows_map` maps each dispatch row back to
         # a representative snapshot row (absolute index) for miss
@@ -1674,9 +1681,71 @@ class DictAggregator:
         w = self.stats["windows"]
         n = self._next_id
         keep = (w - self._last_seen[:n]) < self._rotate_min_age
-        kept = np.flatnonzero(keep)
-        if len(kept) == n:
+        if int(keep.sum()) == n:
             return  # nothing cold yet; stay in sketch-degraded mode
+        self._compact_ids(keep)
+        self.stats["rotations"] = self.stats.get("rotations", 0) + 1
+
+    def invalidate_pid(self, pid: int) -> bool:
+        """Generation-stamped identity invalidation (process/identity.py):
+        the pid was RECYCLED, so every stack id and the location registry
+        it owns describe a DEAD predecessor. Drop them so the new
+        process's stacks re-register against its OWN mapping table
+        instead of resolving through the old binary's registry (the
+        cross-process attribution bug the workload zoo's pid-reuse
+        scenario reproduces). Compaction is safe only at a window
+        boundary — same contract as rotation — so while a close or a
+        deferred miss check is in flight the pid queues and the drop
+        lands at the next first-of-window reset, still before any of the
+        new generation's samples resolve. Returns True when applied
+        immediately, False when deferred."""
+        pid = int(pid)
+        if self._close_handle is not None or self._miss_inflight is not None:
+            self._invalidate_pending.add(pid)
+            return False
+        self._invalidate_pending.discard(pid)
+        self._drop_pids([pid])
+        return True
+
+    def _apply_pending_invalidations(self) -> None:
+        """Deferred invalidate_pid drops, applied at the rotation
+        boundary (first feed of a window: nothing live indexes stack
+        ids). Sorted for a deterministic compaction order."""
+        if not self._invalidate_pending:
+            return
+        if self._close_handle is not None or self._miss_inflight is not None:
+            return
+        pids = sorted(self._invalidate_pending)
+        self._invalidate_pending.clear()
+        self._drop_pids(pids)
+
+    def _drop_pids(self, pids) -> None:
+        n = self._next_id
+        keep = ~np.isin(self._id_pid[:n],
+                        np.asarray(sorted(pids), np.int64).astype(np.int32))
+        for p in pids:
+            self._pids.pop(int(p), None)
+        # Registry content changed even when the pid owned no stack ids
+        # yet (an adopted-but-never-fed registry still must not survive).
+        self._reg_version += 1
+        self.stats["pid_invalidations"] = \
+            self.stats.get("pid_invalidations", 0) + len(pids)
+        if int(keep.sum()) != n:
+            self._compact_ids(keep)
+            # Bumps registry_epoch (mirrors key validity on it) — an id
+            # remap without an epoch bump would let the window encoder
+            # serve stale statics for the recycled pid.
+            self.stats["invalidation_compactions"] = \
+                self.stats.get("invalidation_compactions", 0) + 1
+
+    def _compact_ids(self, keep: np.ndarray) -> None:
+        """Remap the id space to the `keep` survivors and rebuild every
+        structure keyed by stack id (shared by rotation and pid
+        invalidation; callers bump their own epoch stat). Window-boundary
+        only: no live accumulator, fetched counts buffer, or profile
+        build may index ids across this call."""
+        n = self._next_id
+        kept = np.flatnonzero(keep)
         old_to_new = np.full(n, -1, np.int64)
         old_to_new[kept] = np.arange(len(kept))
         # Compact the ragged per-id metadata to the survivors.
@@ -1738,7 +1807,6 @@ class DictAggregator:
         self._prev_counts = None
         self._prev_n_over = 0  # sideband prediction resets with it
         self._reg_version += 1
-        self.stats["rotations"] = self.stats.get("rotations", 0) + 1
 
     # -- internals ----------------------------------------------------------
 

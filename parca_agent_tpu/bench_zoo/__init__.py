@@ -1,0 +1,19 @@
+"""Workload zoo: scenario breadth as a scored matrix.
+
+Seeded, deterministic hostile-world scenarios (pid reuse under tenant
+migration, JIT perf-map churn, fork/exec storms, deep stacks,
+kernel-heavy mixes, multi-tenant bursts), each driven through the REAL
+profiler window loop and scored against per-scenario bars. Entry
+points: ``build_schedule`` (deterministic sweep plan), ``run_scenario``
+(one matrix row), ``run_zoo`` (the whole matrix — what ``make
+bench-zoo`` runs). See docs/robustness.md's workload-zoo section.
+"""
+
+from parca_agent_tpu.bench_zoo.runner import run_scenario, run_zoo
+from parca_agent_tpu.bench_zoo.scenarios import (
+    SCENARIOS, Scenario, ZooWindow, build_schedule, make_snapshot)
+
+__all__ = [
+    "SCENARIOS", "Scenario", "ZooWindow", "build_schedule",
+    "make_snapshot", "run_scenario", "run_zoo",
+]

@@ -1,0 +1,248 @@
+"""Workload-zoo runner: score scenarios through the REAL window loop.
+
+No simulation shortcuts: every scenario's windows flow through a live
+``CPUProfiler.run_iteration`` with the production components wired the
+way cli.py wires them — DictAggregator (scalar close path), Symbolizer
+over a PerfMapCache + KsymCache on the scenario's FakeFS, quarantine
+registry, admission controller with its TenantResolver reading the
+scenario's fake cgroups, and the generation-stamped
+ProcessIdentityTracker with the same invalidator set the CLI registers.
+The scenario only supplies the WORLD: snapshots, procfs files, and
+per-pid starttimes, mutated window by window exactly as a hostile host
+would mutate them under the agent.
+
+Scoring: every row carries the base bars (windows_lost == 0, sample
+mass conserved end to end, close-latency ceiling) plus the scenario's
+own (reuse detected, abuser quarantined, byte identity, ...). A row
+passes only if every bar holds; ``run_zoo`` is the matrix sweep
+``make bench-zoo`` and tests/test_zoo.py drive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import time
+
+import numpy as np
+
+from parca_agent_tpu.aggregator.dict import DictAggregator
+from parca_agent_tpu.bench_zoo.scenarios import (
+    SCENARIOS, Scenario, ZooWindow, build_schedule)
+from parca_agent_tpu.process.identity import ProcessIdentityTracker
+from parca_agent_tpu.profiler.cpu import CPUProfiler
+from parca_agent_tpu.runtime.admission import (
+    AdmissionController, TenantResolver)
+from parca_agent_tpu.runtime.quarantine import QuarantineRegistry
+from parca_agent_tpu.symbolize.ksym import KsymCache
+from parca_agent_tpu.symbolize.perfmap import PerfMapCache
+from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+from parca_agent_tpu.utils.vfs import FakeFS
+
+# Per-scenario close-latency ceiling (seconds). The zoo runs tiny
+# windows on the scalar path; a close that takes longer than this is a
+# regression even on a loaded CI box.
+DEFAULT_CLOSE_CEILING_S = 2.0
+
+
+class _ZooSource:
+    """Capture source over a scenario's window stream: applies each
+    window's world mutations (procfs files, starttimes) BEFORE handing
+    the snapshot over, exactly as the real world mutates under a poll."""
+
+    def __init__(self, windows: list[ZooWindow], fs: FakeFS,
+                 world: dict[int, int]):
+        self._windows = windows
+        self._fs = fs
+        self._world = world
+        self.current = -1
+
+    def poll(self):
+        i = self.current + 1
+        if i >= len(self._windows):
+            return None
+        zw = self._windows[i]
+        for path in sorted(zw.files):
+            self._fs.put(path, zw.files[path])
+        self._world.update(zw.starttimes)
+        self.current = i
+        return zw.snapshot
+
+
+class _ZooWriter:
+    """Profile sink recording (window, labels, pprof bytes) triples."""
+
+    def __init__(self, source: _ZooSource):
+        self._source = source
+        self.shipped: list[tuple[int, dict, bytes]] = []
+
+    def write(self, labels: dict, blob: bytes) -> None:
+        self.shipped.append((self._source.current, dict(labels), blob))
+
+
+class _RecordingAggregator:
+    """Transparent DictAggregator proxy that keeps each window's
+    pre-ladder profile objects for scoring (the profiler ships the same
+    objects, so symbolization results are visible here too)."""
+
+    def __init__(self, inner: DictAggregator):
+        self._inner = inner
+        self.windows: list[list] = []
+
+    def aggregate(self, snapshot):
+        profiles = self._inner.aggregate(snapshot)
+        self.windows.append(list(profiles))
+        return profiles
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@dataclasses.dataclass
+class RunContext:
+    """Everything a scenario's check() may inspect after the run."""
+
+    profiles_by_window: list[list]
+    shipped: list[tuple[int, dict, bytes]]
+    truth: dict
+    aggregator: DictAggregator
+    identity: ProcessIdentityTracker
+    admission: AdmissionController
+    quarantine: QuarantineRegistry
+    resolver: TenantResolver
+    perf: PerfMapCache
+
+
+def _digest(ctx: RunContext) -> str:
+    """Canonical run digest: the seeded-determinism handle. Covers the
+    scored substance (per-window profile tables + shipped bytes), never
+    wall-clock measurements."""
+    h = hashlib.sha256()
+    for w, profs in enumerate(ctx.profiles_by_window):
+        for p in sorted(profs, key=lambda p: p.pid):
+            h.update(repr((
+                w, p.pid, p.values.tolist(),
+                p.stack_loc_ids[:, :8].tolist(), p.stack_depths.tolist(),
+                p.loc_address.tolist(), p.loc_normalized.tolist(),
+                p.loc_mapping_id.tolist(),
+                [(m.id, m.path, m.start) for m in p.mappings],
+                sorted(f[0] for f in p.functions),
+            )).encode())
+    for w, labels, blob in ctx.shipped:
+        h.update(repr((w, sorted(labels.items()))).encode())
+        h.update(hashlib.sha256(blob).digest())
+    return h.hexdigest()
+
+
+def run_scenario(scenario, seed: int, scale: float = 1.0,
+                 hardened: bool | None = None) -> dict:
+    """One matrix row: build the scenario's windows, drive them through
+    the real profiler loop, and score against the bars. ``hardened``
+    None follows PARCA_NO_PID_GENERATION (the control-arm pin)."""
+    scn: Scenario = (SCENARIOS[scenario]()
+                     if isinstance(scenario, str) else scenario)
+    if hardened is None:
+        hardened = os.environ.get("PARCA_NO_PID_GENERATION", "") != "1"
+    windows = scn.build(seed, scale)
+    cfg = scn.config(scale)
+
+    fs = FakeFS()
+    world: dict[int, int] = {}
+    resolver = TenantResolver(fs=fs)
+    admission = AdmissionController(resolver, **cfg.get("admission", {}))
+    quarantine = QuarantineRegistry(**cfg.get("quarantine", {}))
+    perf = PerfMapCache(fs=fs, churn_budget=int(cfg.get("churn_budget", 8)))
+    ksym = None
+    if cfg.get("kallsyms"):
+        fs.put("/proc/kallsyms", cfg["kallsyms"])
+        ksym = KsymCache(fs=fs)
+    symbolizer = Symbolizer(ksym=ksym, perf=perf,
+                            quarantine=quarantine, admission=admission)
+    inner = DictAggregator(capacity=1 << 14)
+    agg = _RecordingAggregator(inner)
+    identity = ProcessIdentityTracker(
+        starttime_of=world.__getitem__, enabled=hardened)
+    # The same invalidator set cli.py registers: every bare-pid cache
+    # drops the dead generation's state on a starttime mismatch.
+    identity.add_invalidator("aggregator", inner.invalidate_pid)
+    identity.add_invalidator("quarantine", quarantine.forget_pid)
+    identity.add_invalidator("tenant", resolver.forget)
+    identity.add_invalidator("perfmap", perf.evict)
+
+    source = _ZooSource(windows, fs, world)
+    writer = _ZooWriter(source)
+    profiler = CPUProfiler(
+        source, agg, symbolizer=symbolizer, profile_writer=writer,
+        quarantine=quarantine, admission=admission, identity=identity)
+
+    close_lat: list[float] = []
+    t0 = time.perf_counter()
+    while profiler.run_iteration():
+        close_lat.append(profiler.metrics.last_aggregate_duration_s)
+    wall_s = time.perf_counter() - t0
+
+    ctx = RunContext(
+        profiles_by_window=agg.windows, shipped=writer.shipped,
+        truth=scn.truth, aggregator=inner, identity=identity,
+        admission=admission, quarantine=quarantine, resolver=resolver,
+        perf=perf)
+
+    samples_fed = int(sum(int(zw.snapshot.counts.sum()) for zw in windows))
+    samples_shipped = int(sum(p.total() for profs in agg.windows
+                              for p in profs))
+    ceiling = float(cfg.get("close_latency_ceiling_s",
+                            DEFAULT_CLOSE_CEILING_S))
+    outcome = {
+        "scenario": scn.name,
+        "axis": scn.axis,
+        "description": scn.description,
+        "seed": int(seed),
+        "scale": float(scale),
+        "hardened": bool(hardened),
+        "windows": len(windows),
+        "degraded_builds": int(scn.truth.get("degraded_builds", 0)),
+        "windows_lost": int(profiler.metrics.errors_total),
+        "windows_closed": len(agg.windows),
+        "profiles_written": int(profiler.metrics.profiles_written),
+        "samples_fed": samples_fed,
+        "samples_shipped": samples_shipped,
+        "close_latency_max_s": max(close_lat, default=0.0),
+        "close_latency_ceiling_s": ceiling,
+        "wall_s": wall_s,
+        "identity": identity.metrics(),
+        "admission": dict(admission.stats),
+        "quarantine": dict(quarantine.stats),
+        "perfmap": dict(perf.stats),
+        "tenant_resolver": dict(resolver.stats),
+    }
+    bars = {
+        "windows_lost_zero": outcome["windows_lost"] == 0,
+        "every_window_closed": outcome["windows_closed"] == len(windows),
+        "mass_conserved": samples_shipped == samples_fed,
+        "close_latency_ceiling":
+            outcome["close_latency_max_s"] <= ceiling,
+    }
+    bars.update(scn.check(outcome, ctx))
+    outcome["bars"] = bars
+    outcome["passed"] = all(bars.values())
+    outcome["digest"] = _digest(ctx)
+    return outcome
+
+
+def run_zoo(seed: int, scale: float = 1.0, names=None,
+            hardened: bool | None = None) -> dict:
+    """The full matrix sweep: a deterministic schedule of scenario rows,
+    each scored through the real window loop."""
+    schedule = build_schedule(seed, names)
+    rows = [run_scenario(e["scenario"], e["seed"], scale=scale,
+                         hardened=hardened) for e in schedule]
+    return {
+        "seed": int(seed),
+        "scale": float(scale),
+        "schedule": schedule,
+        "rows": rows,
+        "scenarios_passed": sum(r["passed"] for r in rows),
+        "scenarios_total": len(rows),
+        "passed": bool(rows) and all(r["passed"] for r in rows),
+    }

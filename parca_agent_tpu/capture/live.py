@@ -514,6 +514,16 @@ class UnwindTableCache:
                 self._ensure_worker()
             return t
 
+    def evict(self, pid: int) -> None:
+        """Drop a pid's table immediately (generation-stamped identity
+        invalidation, process/identity.py: a recycled pid must not
+        unwind through its dead predecessor's tables). A queued rebuild
+        may stay queued — it reads the pid's CURRENT maps, which is
+        exactly the fresh state we want."""
+        with self._lock:
+            self._tables.pop(pid, None)
+            self._built_at.pop(pid, None)
+
     def _ensure_worker(self) -> None:
         if self._worker is None or not self._worker.is_alive():
             self._worker = threading.Thread(

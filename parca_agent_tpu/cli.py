@@ -12,6 +12,7 @@ Run: python -m parca_agent_tpu --help
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import threading
 
@@ -167,6 +168,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--overload-recover-after", type=int, default=6,
                    help="consecutive in-budget windows before the "
                         "governor releases one shed step")
+    p.add_argument("--fork-storm-new-pids", type=int, default=0,
+                   help="fork/exec-storm admission: never-seen pids "
+                        "appearing in one window past which the "
+                        "governor sheds one ladder rung from the "
+                        "heaviest tenants (discovery-burst containment "
+                        "— per-new-pid maps/unwind/registry work is "
+                        "paid before any quota sees a sample; requires "
+                        "tenant quotas to be active; 0 disables)")
+    p.add_argument("--no-pid-generation", action="store_true",
+                   help="disable generation-stamped process identity "
+                        "(pid-reuse detection via /proc/<pid>/stat "
+                        "starttime + stale-state invalidation, "
+                        "docs/robustness.md \"workload zoo\"); "
+                        "PARCA_NO_PID_GENERATION=1 does the same")
     p.add_argument("--remote-store-insecure-skip-verify",
                    action="store_true",
                    help="skip TLS certificate verification: the server's "
@@ -729,12 +744,15 @@ def run(argv=None) -> int:
                 raise SystemExit(f"{flag} must be >= 1")
         if args.overload_close_latency < 0:
             raise SystemExit("--overload-close-latency must be >= 0")
+        if args.fork_storm_new_pids < 0:
+            raise SystemExit("--fork-storm-new-pids must be >= 0")
         tenant_resolver = TenantResolver()
         admission = AdmissionController(
             tenant_resolver,
             quota_samples=args.tenant_quota_samples,
             quota_pids=args.tenant_quota_pids,
             burst_windows=args.tenant_burst_windows,
+            storm_new_pids=args.fork_storm_new_pids,
             overload=OverloadPolicy(
                 close_latency_s=args.overload_close_latency,
                 registry_rows=args.overload_registry_rows,
@@ -920,6 +938,36 @@ def run(argv=None) -> int:
             quarantine.tenant_of = tenant_resolver.resolve
         if hasattr(source, "quarantine"):
             source.quarantine = quarantine
+
+    # -- generation-stamped process identity ---------------------------------
+    # Pid-reuse detection on (pid, /proc/<pid>/starttime), observed once
+    # per window by the profiler loop. A recycled pid fires every
+    # registered invalidator so no layer hands the new process its dead
+    # predecessor's state: maps cache, perf-map cache, DWARF unwind
+    # tables, quarantine budget, tenant resolution, and the aggregator's
+    # per-pid location registry (docs/robustness.md "workload zoo").
+    identity = None
+    perf_cache = None
+    if not (args.no_pid_generation
+            or os.environ.get("PARCA_NO_PID_GENERATION", "") == "1"):
+        from parca_agent_tpu.process.identity import ProcessIdentityTracker
+        from parca_agent_tpu.symbolize.perfmap import PerfMapCache as _PMC
+
+        identity = ProcessIdentityTracker()
+        perf_cache = _PMC()
+        identity.add_invalidator("perfmap", perf_cache.evict)
+        maps_cache = getattr(source, "_maps", None)
+        if maps_cache is not None and hasattr(maps_cache, "evict"):
+            identity.add_invalidator("maps", maps_cache.evict)
+        unwind_cache = getattr(source, "_tables", None)
+        if unwind_cache is not None and hasattr(unwind_cache, "evict"):
+            identity.add_invalidator("unwind", unwind_cache.evict)
+        if quarantine is not None:
+            identity.add_invalidator("quarantine", quarantine.forget_pid)
+        if tenant_resolver is not None:
+            identity.add_invalidator("tenant", tenant_resolver.forget)
+        if hasattr(aggregator, "invalidate_pid"):
+            identity.add_invalidator("aggregator", aggregator.invalidate_pid)
     feeder = None
     if args.debug_process_names:
         from parca_agent_tpu.capture.live import CommFilterSource
@@ -1155,7 +1203,9 @@ def run(argv=None) -> int:
         aggregator=aggregator,
         fallback_aggregator=fallback,
         symbolizer=(None if args.fast_encode
-                    else Symbolizer(ksym=KsymCache(), perf=PerfMapCache(),
+                    else Symbolizer(ksym=KsymCache(),
+                                    perf=(perf_cache if perf_cache
+                                          is not None else PerfMapCache()),
                                     quarantine=quarantine,
                                     admission=admission)),
         labels_manager=labels_mgr,
@@ -1173,6 +1223,7 @@ def run(argv=None) -> int:
         encode_deadline_s=args.encode_deadline or None,
         quarantine=quarantine,
         admission=admission,
+        identity=identity,
         device_health=device_health,
         statics_store=statics_store,
         statics_snapshot_every=args.statics_snapshot_interval,
@@ -1315,6 +1366,7 @@ def run(argv=None) -> int:
                            hotspots=hotspot_store,
                            sinks=sink_registry,
                            admission=admission,
+                           identity=identity,
                            regression=regression_sentinel,
                            device_telemetry=device_telemetry)
 

@@ -1,6 +1,10 @@
 """Process address-space introspection (reference pkg/process, pkg/objectfile,
 pkg/address)."""
 
+from parca_agent_tpu.process.identity import (
+    ProcessIdentityTracker,
+    read_starttime,
+)
 from parca_agent_tpu.process.maps import (
     MapsError,
     ProcMapping,
@@ -12,4 +16,5 @@ from parca_agent_tpu.process.objectfile import ObjectFile, ObjectFileCache
 __all__ = [
     "MapsError", "ProcMapping", "parse_proc_maps", "ProcessMapCache",
     "ObjectFile", "ObjectFileCache",
+    "ProcessIdentityTracker", "read_starttime",
 ]

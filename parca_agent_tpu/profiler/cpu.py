@@ -87,6 +87,7 @@ class CPUProfiler:
         encode_deadline_s: float | None = None,
         quarantine=None,
         admission=None,
+        identity=None,
         device_health=None,
         statics_store=None,
         statics_snapshot_every: int = 6,
@@ -121,6 +122,11 @@ class CPUProfiler:
         # points are fail-open by the controller's own contract, so the
         # calls ride unguarded.
         self._admission = admission
+        # Generation-stamped process identity (process/identity.py):
+        # observed once per window, before accounting/aggregation, so a
+        # recycled pid invalidates its dead predecessor's state instead
+        # of inheriting it.
+        self._identity = identity
         # Fast write path: aggregate counts + vectorized template encoder,
         # no per-pid PidProfile objects or scalar pprof serialization on
         # the hot loop. Profiles ship unsymbolized (the reference agent's
@@ -503,6 +509,13 @@ class CPUProfiler:
             return False
         self.last_profile_started_at = time.time()
         self.metrics.attempts_total += 1
+        if self._identity is not None:
+            # Generation-stamped identity check BEFORE accounting and
+            # aggregation: a recycled pid's stale tenant/quarantine/
+            # registry state must be invalidated before any of the new
+            # generation's samples resolve through it (fail-open by the
+            # tracker's own contract — see process/identity.py).
+            self._identity.observe_window(snapshot.pids)
         if self._admission is not None:
             # Per-tenant usage accounting BEFORE the close: the ladder
             # levels this window's profiles ride were set by last tick

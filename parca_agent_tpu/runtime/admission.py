@@ -284,13 +284,17 @@ class AdmissionController:
 
     _MAX_TENANTS = 4096
     _IDLE_FORGET_WINDOWS = 60
+    # Bound on the fork-storm seen-pid set; past it the set restarts
+    # from the current window (a long-lived fleet cycling through the
+    # pid space must not hold every pid ever observed).
+    _MAX_SEEN_PIDS = 1 << 20
 
     def __init__(self, resolver: TenantResolver,
                  quota_samples: int = 0, quota_pids: int = 0,
                  burst_windows: int = 3, degrade_after: int = 2,
                  escalate_after: int = 3, recover_windows: int = 3,
                  overload: OverloadPolicy | None = None,
-                 top_n: int = 10):
+                 top_n: int = 10, storm_new_pids: int = 0):
         if quota_samples < 0 or quota_pids < 0:
             raise ValueError("tenant quotas must be >= 0")
         self.resolver = resolver
@@ -302,6 +306,14 @@ class AdmissionController:
         self._recover = max(1, int(recover_windows))
         self._overload = overload or OverloadPolicy()
         self._top_n = max(1, int(top_n))
+        # Fork/exec-storm detection: a window introducing more than
+        # `storm_new_pids` never-seen pids (0 = off) degrades via the
+        # governor's shed step — discovery cost (maps parses, unwind
+        # builds, registry inserts) is per NEW pid, paid before any
+        # quota sees a sample.
+        self._storm_threshold = max(0, int(storm_new_pids))
+        self._seen_pids: set[int] = set()   # guarded-by: _lock
+        self._storm_new_window = 0          # guarded-by: _lock
         self._lock = threading.Lock()
         self._tenants: dict[str, _TenantState] = {}  # guarded-by: _lock
         self._over_streak = 0       # guarded-by: _lock
@@ -319,6 +331,8 @@ class AdmissionController:
             "shed_errors_total": 0,
             "samples_degraded_total": 0,
             "account_errors_total": 0,
+            "fork_storm_windows_total": 0,
+            "fork_storm_sheds_total": 0,
         }
 
     # -- per-window accounting (profiler thread) -----------------------------
@@ -348,6 +362,13 @@ class AdmissionController:
                     st.pids_window += n_pids
                     st.samples_total += samples
                     st.idle_windows = 0
+                if self._storm_threshold > 0:
+                    pid_list = upids.tolist()
+                    self._storm_new_window += sum(
+                        1 for p in pid_list if p not in self._seen_pids)
+                    self._seen_pids.update(pid_list)
+                    if len(self._seen_pids) > self._MAX_SEEN_PIDS:
+                        self._seen_pids = set(pid_list)
         except Exception as e:  # noqa: BLE001 - counted, fail-open
             with self._lock:
                 self.stats["account_errors_total"] += 1
@@ -409,6 +430,7 @@ class AdmissionController:
                     del self._tenants[tenant]
                 self._govern_locked(close_latency_s, registry_rows,
                                     backlog)
+                self._storm_tick_locked()
                 self.stats["tenants_tracked"] = len(self._tenants)
                 self.stats["tenants_degraded"] = sum(
                     1 for st in self._tenants.values()
@@ -497,6 +519,26 @@ class AdmissionController:
             if self._calm_streak >= self._overload.recover_after:
                 self._calm_streak = 0
                 self._release_locked()
+
+    def _storm_tick_locked(self) -> None:  # palint: holds=_lock
+        """Fork/exec-storm admission: when one window introduced more
+        never-seen pids than the threshold (container churn, serverless
+        cold-start bursts), degrade via the EXISTING governor shed step
+        — heaviest tenants ride the ladder one rung, samples still
+        travel — instead of letting per-new-pid discovery work blow the
+        window. Recovery rides the governor's normal calm-streak
+        release; a quiet fleet pays nothing (threshold 0 = off)."""
+        if self._storm_threshold <= 0:
+            return
+        n_new = self._storm_new_window
+        self._storm_new_window = 0
+        if n_new <= self._storm_threshold:
+            return
+        self.stats["fork_storm_windows_total"] += 1
+        self._shed_locked()
+        self.stats["fork_storm_sheds_total"] += 1
+        _log.warn("fork storm: shedding one ladder rung",
+                  new_pids=n_new, threshold=self._storm_threshold)
 
     def _shed_locked(self) -> None:  # palint: holds=_lock
         """One shed step: degrade the heaviest SHEDDABLE tenants (by

@@ -109,7 +109,20 @@ class QuarantineRegistry:
             "recoveries_total": 0,
             "samples_degraded_total": 0,
             "windows_salvaged_total": 0,
+            "pids_forgotten_total": 0,
         }
+
+    def forget_pid(self, pid: int) -> None:
+        """Generation-stamped identity invalidation (process/identity.py):
+        the pid was RECYCLED, so its tracked strikes/trips/ladder state
+        belongs to a dead predecessor — a fresh innocent process must
+        start with a clean budget, and a fresh hostile one must re-earn
+        its quarantine (the tick_window docstring has always named this
+        exact hazard). Dropping under the lock is the whole operation;
+        unknown pids are a no-op."""
+        with self._lock:
+            if self._pids.pop(int(pid), None) is not None:
+                self.stats["pids_forgotten_total"] += 1
 
     # -- fault reporting -----------------------------------------------------
 

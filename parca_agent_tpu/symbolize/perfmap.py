@@ -137,31 +137,96 @@ def perf_map_path(fs: VFS, pid: int) -> str:
     return f"/proc/{pid}/root/tmp/perf-{nspid}.map"
 
 
-class PerfMapCache:
-    """map_for_pid(pid) -> PerfMap, hash-invalidated per pid."""
+# Consecutive content-changed reparses a single pid's map may burn
+# before the cache declares churn abuse and raises (charging the PR 4
+# poison budget through the symbolizer's PoisonInput path). A healthy
+# JIT appends — its rewrites settle; a map rewritten with NEW content on
+# every single read is either a runaway runtime or an adversary feeding
+# the parser, and either way the parse work stops. A read observing
+# UNCHANGED content resets the streak.
+_CHURN_BUDGET = 8
 
-    def __init__(self, fs: VFS | None = None):
+
+class PerfMapCache:
+    """map_for_pid(pid) -> PerfMap with two-tier invalidation.
+
+    Tier 1 is the stat signature (``VFS.stat_signature``: dev/inode/
+    size/mtime on a real fs, a content version on the fake) — unchanged
+    signature returns the cached map WITHOUT touching file contents, so
+    a stable JVM map costs one stat per window instead of a re-read and
+    re-hash of tens of MB. Tier 2 is the content hash: a changed
+    signature re-reads, and only changed BYTES re-parse (a touch/rewrite
+    with identical content refreshes the signature and resets the churn
+    streak). Actual reparses are counted (``reparse_total`` — exported
+    as parca_agent_perfmap_reparse_total) and budgeted: past
+    ``churn_budget`` consecutive content changes the entry is dropped
+    and PerfMapError is raised, which the symbolizer's existing
+    PoisonInput handler charges to the pid's quarantine budget — churn
+    abuse rides the same ladder as any other poisoned input."""
+
+    def __init__(self, fs: VFS | None = None,
+                 churn_budget: int = _CHURN_BUDGET):
         self._fs = fs or RealFS()
-        self._cache: dict[int, tuple[int, PerfMap]] = {}
+        # pid -> [stat_sig, content_hash, PerfMap, churn_streak]
+        self._cache: dict[int, list] = {}
+        self._budget = max(1, int(churn_budget))
+        self.stats = {
+            "stat_hits_total": 0,
+            "reads_total": 0,
+            "parses_total": 0,
+            "reparse_total": 0,
+            "churn_trips_total": 0,
+        }
 
     def map_for_pid(self, pid: int) -> PerfMap:
         """Raises FileNotFoundError when the process has no perf map and
         PoisonInput (PerfMapError or OversizedInput) when the map it
-        does have is poison.
+        does have is poison — including churn abuse (see class doc).
 
         The read itself is BOUNDED: the file is written by the profiled
         process, so a multi-GB map must cost at most the byte cap of RSS
         — never a full materialization before the cap check."""
         faults.inject("perfmap.parse")
         path = perf_map_path(self._fs, pid)
+        ent = self._cache.get(pid)
+        try:
+            sig = self._fs.stat_signature(path)
+        except OSError:
+            # Stat is an optimization, not a gate: the bounded read
+            # below owns the authoritative error (FileNotFoundError for
+            # a mapless process, PoisonInput for a hostile stream — a
+            # fake/test fs may serve open() for paths it cannot stat).
+            sig = None
+        if ent is not None and sig is not None and ent[0] == sig:
+            self.stats["stat_hits_total"] += 1
+            return ent[2]
         data = read_bounded(self._fs, path, _MAX_BYTES,
                             site="perfmap.parse")
+        self.stats["reads_total"] += 1
         h = hash_bytes(data)
-        cached = self._cache.get(pid)
-        if cached and cached[0] == h:
-            return cached[1]
+        if ent is not None and ent[1] == h:
+            # Touched but not changed (mtime bump, rewrite-in-place with
+            # identical bytes): refresh the signature, forgive the streak.
+            ent[0] = sig
+            ent[3] = 0
+            return ent[2]
+        if ent is not None:
+            streak = ent[3] + 1
+            if streak >= self._budget:
+                # Drop the entry so a post-probation retry starts with a
+                # fresh budget instead of tripping forever.
+                del self._cache[pid]
+                self.stats["churn_trips_total"] += 1
+                raise PerfMapError(
+                    f"perf map churn abuse: {streak} consecutive "
+                    f"content rewrites (budget {self._budget})")
+        else:
+            streak = 0
         m = parse_perf_map(data)
-        self._cache[pid] = (h, m)
+        self.stats["parses_total"] += 1
+        if ent is not None:
+            self.stats["reparse_total"] += 1
+        self._cache[pid] = [sig, h, m, streak]
         return m
 
     def evict(self, pid: int) -> None:

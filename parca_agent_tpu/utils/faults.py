@@ -183,6 +183,10 @@ SITES = {
     "fleet.collective": "one fleet merge/re-probe collective round",
     "device.telemetry":
         "device flight-recorder entry points (runtime/device_telemetry.py)",
+    "process.identity":
+        "per-window pid generation check (process/identity.py)",
+    "zoo.scenario":
+        "one zoo scenario window build (bench_zoo/scenarios.py)",
 }
 
 

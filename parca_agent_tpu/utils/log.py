@@ -21,6 +21,8 @@ configure logging themselves are not surprised by double output.
 from __future__ import annotations
 
 import logging
+import os
+import sys
 import time
 
 _ROOT = "parca_agent_tpu"
@@ -46,10 +48,15 @@ class LogfmtFormatter(logging.Formatter):
         level = {logging.ERROR: "error", logging.WARNING: "warn",
                  logging.INFO: "info", logging.DEBUG: "debug"}.get(
                      record.levelno, record.levelname.lower())
+        # Prefer the facade's explicitly captured caller: logging's own
+        # findCaller walks `stacklevel` frames, whose accounting differs
+        # between 3.10 and 3.11+ — the explicit frame is version-proof.
+        caller = getattr(record, "logfmt_caller", None) \
+            or f"{record.filename}:{record.lineno}"
         parts = [
             f"ts={ts}.{int(record.msecs):03d}Z",
             f"level={level}",
-            f"caller={record.filename}:{record.lineno}",
+            f"caller={caller}",
             f"component={record.name.removeprefix(_ROOT + '.') or 'agent'}",
             f"msg={_quote(record.getMessage())}",
         ]
@@ -68,9 +75,21 @@ class Logger:
 
     def _log(self, level: int, msg: str, exc=None, **kv) -> None:
         if self._logger.isEnabledFor(level):
-            self._logger._log(  # stacklevel only exists on the public
-                level, msg, (), exc_info=exc,  # methods; _log keeps the
-                extra={"logfmt_kv": kv}, stacklevel=3)  # caller accurate
+            # Capture the real caller ourselves: frame 0 is this _log,
+            # frame 1 the public facade method (info/debug/...), frame 2
+            # the call site. stdlib `stacklevel` walks frames with
+            # version-dependent accounting (3.10 lands one frame off
+            # under pytest's importer), so the explicit frame is the
+            # only portable source of caller=file:line.
+            try:
+                f = sys._getframe(2)
+                caller = (f"{os.path.basename(f.f_code.co_filename)}"
+                          f":{f.f_lineno}")
+            except Exception:
+                caller = None
+            self._logger._log(
+                level, msg, (), exc_info=exc,
+                extra={"logfmt_kv": kv, "logfmt_caller": caller})
 
     def debug(self, msg: str, **kv) -> None:
         self._log(logging.DEBUG, msg, **kv)

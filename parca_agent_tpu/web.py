@@ -209,7 +209,7 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    supervisor=None, quarantine=None,
                    device_health=None, statics_store=None,
                    recorder=None, hotspots=None, sinks=None,
-                   admission=None, regression=None,
+                   admission=None, identity=None, regression=None,
                    device_telemetry=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics and
@@ -255,6 +255,18 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
             for k, v in pipe.stats.items():
                 emit(f"parca_agent_encode_pipeline_{k}",
                      round(v, 6) if isinstance(v, float) else v, lab)
+        perf = getattr(getattr(p, "_symbolizer", None), "_perf", None)
+        perf_stats = getattr(perf, "stats", None)
+        if isinstance(perf_stats, dict):
+            # JIT perf-map cache: actual content reparses (the churn
+            # signal the zoo's jit-churn bar keys on), cheap stat-hit
+            # short-circuits, and churn-abuse poison trips.
+            emit("parca_agent_perfmap_reparse_total",
+                 perf_stats.get("reparse_total", 0), lab)
+            emit("parca_agent_perfmap_stat_hits_total",
+                 perf_stats.get("stat_hits_total", 0), lab)
+            emit("parca_agent_perfmap_churn_trips_total",
+                 perf_stats.get("churn_trips_total", 0), lab)
         agg_stats = getattr(getattr(p, "_aggregator", None), "stats", None)
         if isinstance(agg_stats, dict) and "windows" in agg_stats:
             # Sub-RTT close observability (docs/perf.md "sub-RTT close"):
@@ -551,10 +563,28 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
             emit("parca_agent_tenant_window_pids", t["pids"], lab)
             emit("parca_agent_tenant_ladder_level", t["level"], lab)
             emit("parca_agent_tenant_over_quota", t["over_quota"], lab)
-        for k, v in m["stats"].items():
+        stats = dict(m["stats"])
+        # Fork/exec-storm containment gets its own first-class family
+        # (the zoo's fork-storm bar keys on it); the rest of the
+        # controller's counters export under the generic prefix.
+        emit("parca_agent_fork_storm_shed_total",
+             stats.pop("fork_storm_sheds_total", 0))
+        for k, v in stats.items():
             emit(f"parca_agent_admission_{k}", v)
         for k, v in m["resolver"].items():
             emit(f"parca_agent_tenant_{k}", v)
+    if identity is not None:
+        # Generation-stamped process identity (process/identity.py):
+        # pid-reuse detections and the invalidation fan-out behind them.
+        m = identity.metrics()
+        emit("parca_agent_pid_reuse_detected_total",
+             m.get("reuse_detected_total", 0))
+        emit("parca_agent_pid_identity_checks_total",
+             m.get("checks_total", 0))
+        emit("parca_agent_pid_identity_invalidations_total",
+             m.get("invalidations_total", 0))
+        emit("parca_agent_pid_identity_errors_total",
+             m.get("errors_total", 0))
     if regression is not None:
         # Regression sentinel (docs/regression.md): verdict counters by
         # kind, the fold/seal/baseline lifecycle counters, judgment
@@ -627,7 +657,7 @@ class AgentHTTPServer:
                  capture_info=None, supervisor=None, quarantine=None,
                  device_health=None, statics_store=None, recorder=None,
                  hotspots=None, sinks=None, admission=None,
-                 regression=None, device_telemetry=None):
+                 identity=None, regression=None, device_telemetry=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -660,6 +690,7 @@ class AgentHTTPServer:
                         hotspots=outer.hotspots,
                         sinks=outer.sinks,
                         admission=outer.admission,
+                        identity=outer.identity,
                         regression=outer.regression,
                         device_telemetry=outer.device_telemetry).encode())
                 elif url.path == "/healthy":
@@ -807,6 +838,8 @@ class AgentHTTPServer:
                          if outer.sinks is not None else None)
                 admission = (outer.admission.snapshot()
                              if outer.admission is not None else None)
+                identity = (outer.identity.snapshot()
+                            if outer.identity is not None else None)
                 regression = (outer.regression.snapshot()
                               if outer.regression is not None else None)
                 if outer.supervisor is None:
@@ -823,6 +856,8 @@ class AgentHTTPServer:
                         body["sinks"] = sinks
                     if admission is not None:
                         body["admission"] = admission
+                    if identity is not None:
+                        body["process_identity"] = identity
                     if regression is not None:
                         body["regression"] = regression
                     self._send(200, json.dumps(body).encode(),
@@ -868,6 +903,13 @@ class AgentHTTPServer:
                     # and governor sheds are surfaced for operators and
                     # by contract never turn readiness red.
                     body["admission"] = admission
+                if identity is not None:
+                    # Pid reuse is a property of the PROFILED FLEET, and
+                    # detecting it is the agent working as designed: the
+                    # reuse/invalidation counters are surfaced for
+                    # operators and by contract never turn readiness
+                    # red (docs/robustness.md "workload zoo").
+                    body["process_identity"] = identity
                 if regression is not None:
                     # Regression verdicts are judgments about the
                     # PROFILED WORKLOAD, not about the agent: a fleet of
@@ -1016,6 +1058,7 @@ class AgentHTTPServer:
         self.hotspots = hotspots
         self.sinks = sinks
         self.admission = admission
+        self.identity = identity
         self.regression = regression
         self.device_telemetry = device_telemetry
         self.version = version

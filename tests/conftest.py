@@ -18,6 +18,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+import importlib.util  # noqa: E402
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
@@ -53,8 +55,27 @@ _SHARD_MAP_MIXED_MODULES = frozenset(
 _SHARD_MAP_NAME_FRAGMENT = "sharded"
 
 
+# -- requires_pyelftools: differential ELF/DWARF comparisons -----------------
+# A handful of tests cross-check the in-repo ELF/DWARF parsers against
+# pyelftools; an environment without pyelftools cannot run the
+# comparison at all — same ENVIRONMENT-property reasoning as
+# requires_shard_map above, so those report as skips, not failures. The
+# affected tests all carry "pyelftools" in their names.
+HAVE_PYELFTOOLS = importlib.util.find_spec("elftools") is not None
+
+requires_pyelftools = pytest.mark.skipif(
+    not HAVE_PYELFTOOLS,
+    reason="pyelftools is not installed (differential ELF/DWARF "
+           "comparisons need it)")
+
+_PYELFTOOLS_NAME_FRAGMENT = "pyelftools"
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        if item.get_closest_marker("requires_pyelftools") is not None \
+                or _PYELFTOOLS_NAME_FRAGMENT in item.name:
+            item.add_marker(requires_pyelftools)
         if item.get_closest_marker("requires_shard_map") is None:
             mod = item.module.__name__
             if mod not in _SHARD_MAP_MODULES \

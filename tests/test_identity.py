@@ -1,0 +1,244 @@
+"""Generation-stamped process identity (chaos) suite.
+
+Pid reuse is the quiet data-corruption path of a procfs profiler: the
+kernel hands a recycled pid to a NEW process and every bare-pid cache in
+the agent — the aggregator's per-pid location registry above all —
+silently attributes the new process's samples to the dead one's binary.
+process/identity.py stamps identity the way the kernel does, ``(pid,
+starttime)``, and fires per-layer invalidators on a mismatch. This suite
+pins: starttime parsing, reuse detection and invalidator fan-out, the
+aggregator/quarantine invalidation semantics, the cross-process
+attribution REGRESSION (the bug must reproduce with the stamp pinned
+off, and vanish with it on — through the real window loop, via the
+workload zoo's pid-reuse scenario), and the ``process.identity`` chaos
+site's fail-open contract.
+"""
+
+import numpy as np
+import pytest
+
+from parca_agent_tpu.aggregator.dict import DictAggregator
+from parca_agent_tpu.bench_zoo import run_scenario
+from parca_agent_tpu.capture.formats import STACK_SLOTS, WindowSnapshot
+from parca_agent_tpu.process.identity import (
+    ProcessIdentityTracker, read_starttime)
+from parca_agent_tpu.process.maps import ProcMapping, build_mapping_table
+from parca_agent_tpu.runtime.quarantine import QuarantineRegistry
+from parca_agent_tpu.utils import faults
+from parca_agent_tpu.utils.vfs import FakeFS
+
+pytestmark = pytest.mark.chaos
+
+# The chaos site this module drills (utils/faults.py SITES).
+SITE = "process.identity"
+
+
+# -- starttime parsing --------------------------------------------------------
+
+def test_read_starttime_parses_field_22():
+    # comm may embed spaces AND parens; parsing must anchor after the
+    # LAST ')'. starttime is field 22 (1-based), index 19 after comm.
+    rest = ["R", "1", "1", "1", "0", "-1", "4194560", "0", "0", "0", "0",
+            "5", "6", "0", "0", "20", "0", "1", "0", "123456789", "0"]
+    fs = FakeFS({"/proc/7/stat":
+                 ("7 (a (b) c) " + " ".join(rest)).encode()})
+    assert read_starttime(fs, 7) == 123456789
+
+
+def test_read_starttime_raises_on_garbage():
+    fs = FakeFS({"/proc/7/stat": b"no parens here"})
+    with pytest.raises(ValueError):
+        read_starttime(fs, 7)
+    with pytest.raises(FileNotFoundError):
+        read_starttime(fs, 8)
+
+
+# -- reuse detection + invalidator fan-out ------------------------------------
+
+def _tracker(world):
+    return ProcessIdentityTracker(starttime_of=world.__getitem__,
+                                  enabled=True)
+
+
+def test_same_generation_never_invalidates():
+    world = {10: 100, 11: 200}
+    t = _tracker(world)
+    fired = []
+    t.add_invalidator("rec", fired.append)
+    for _ in range(3):
+        assert t.observe_window([10, 11, 11]) == []
+    assert fired == []
+    assert t.metrics()["reuse_detected_total"] == 0
+    # Duplicate pids in one window are checked once.
+    assert t.metrics()["checks_total"] == 6
+
+
+def test_reuse_fires_every_invalidator_and_survives_a_raising_one():
+    world = {10: 100}
+    t = _tracker(world)
+    fired = []
+    t.add_invalidator("boom", lambda pid: 1 / 0)
+    t.add_invalidator("rec", fired.append)
+    t.observe_window([10])
+    world[10] = 999  # the kernel recycled pid 10
+    assert t.observe_window([10]) == [10]
+    # The raising layer is counted; the next one still dropped state.
+    assert fired == [10]
+    m = t.metrics()
+    assert m["reuse_detected_total"] == 1
+    assert m["invalidations_total"] == 1
+    assert m["invalidation_errors_total"] == 1
+    # The new generation is now the remembered one: no re-fire.
+    assert t.observe_window([10]) == []
+
+
+def test_unreadable_stat_keeps_remembered_generation():
+    # A pid that exits mid-window keeps its entry — if the pid comes
+    # back it is BY DEFINITION a new incarnation, and the stale entry
+    # is exactly what detects it.
+    world = {10: 100}
+    t = _tracker(world)
+    t.observe_window([10])
+    del world[10]  # exited: starttime_of raises KeyError
+    assert t.observe_window([10]) == []
+    assert t.metrics()["errors_total"] == 1
+    world[10] = 555  # recycled
+    assert t.observe_window([10]) == [10]
+
+
+def test_disabled_tracker_is_inert():
+    world = {10: 100}
+    t = ProcessIdentityTracker(starttime_of=world.__getitem__,
+                               enabled=False)
+    fired = []
+    t.add_invalidator("rec", fired.append)
+    t.observe_window([10])
+    world[10] = 999
+    assert t.observe_window([10]) == []
+    assert fired == []
+    assert t.metrics()["reuse_detected_total"] == 0
+
+
+def test_env_flag_pins_hardening_off(monkeypatch):
+    monkeypatch.setenv("PARCA_NO_PID_GENERATION", "1")
+    t = ProcessIdentityTracker(starttime_of=lambda pid: 1)
+    assert t.enabled is False
+    monkeypatch.delenv("PARCA_NO_PID_GENERATION")
+    assert ProcessIdentityTracker(starttime_of=lambda pid: 1).enabled
+
+
+def test_forget_drops_the_generation():
+    world = {10: 100}
+    t = _tracker(world)
+    t.observe_window([10])
+    t.forget(10)
+    world[10] = 999
+    # No remembered generation -> first observation, not a reuse.
+    assert t.observe_window([10]) == []
+
+
+# -- per-layer invalidation semantics -----------------------------------------
+
+def _one_pid_snapshot(pid, path, time_ns=0):
+    maps = {pid: [ProcMapping(start=0x400000, end=0x500000, perms="r-xp",
+                              offset=0, dev="08:01", inode=1, path=path)]}
+    stacks = np.zeros((1, STACK_SLOTS), np.uint64)
+    stacks[0, :3] = [0x400010, 0x400020, 0x400030]
+    return WindowSnapshot(
+        np.array([pid], np.int32), np.array([pid], np.int32),
+        np.array([50], np.int64), np.array([3], np.int32),
+        np.array([0], np.int32), stacks, build_mapping_table(maps),
+        time_ns=time_ns)
+
+
+def test_aggregator_invalidate_pid_rebinds_the_registry():
+    # The tentpole's core fix: after invalidate_pid, the SAME (pid,
+    # stack) key must re-register against the CURRENT mapping table —
+    # without it the recycled pid inherits the dead binary's locations.
+    agg = DictAggregator(capacity=1 << 12)
+    old = agg.aggregate(_one_pid_snapshot(42, "/app/old", time_ns=1))
+    assert old[0].mappings[0].path == "/app/old"
+    epoch = agg.registry_epoch
+    assert agg.invalidate_pid(42) is True
+    assert agg.registry_epoch > epoch  # encoder/statics validity key
+    new = agg.aggregate(_one_pid_snapshot(42, "/app/new", time_ns=2))
+    assert new[0].mappings[0].path == "/app/new"
+    assert new[0].total() == 50
+    assert agg.stats["pid_invalidations"] == 1
+
+
+def test_aggregator_invalidation_without_stamp_inherits_stale_mappings():
+    # The un-hardened failure mode, at the unit level: same pid, same
+    # addresses, NEW binary in the snapshot's table — the registry
+    # still resolves through the dead generation's mapping.
+    agg = DictAggregator(capacity=1 << 12)
+    agg.aggregate(_one_pid_snapshot(42, "/app/old", time_ns=1))
+    new = agg.aggregate(_one_pid_snapshot(42, "/app/new", time_ns=2))
+    assert new[0].mappings[0].path == "/app/old"
+
+
+def test_quarantine_forget_pid_clears_strikes():
+    reg = QuarantineRegistry(max_strikes=2)
+    reg.record_error(9, "perfmap.parse", ValueError("x"))
+    reg.forget_pid(9)
+    # A fresh incarnation re-earns its budget from zero: one more
+    # strike must NOT trip the 2-strike ladder.
+    reg.record_error(9, "perfmap.parse", ValueError("x"))
+    assert reg.level(9) == 0
+    assert reg.stats["pids_forgotten_total"] == 1
+
+
+# -- the regression, end to end through the real window loop ------------------
+
+def test_cross_process_attribution_regression():
+    # Un-hardened arm (the pre-PR agent): tenant B's samples land on
+    # tenant A's binary. Hardened arm: zero misattribution, every
+    # recycled pid detected. Same seed, same windows, same loop.
+    bad = run_scenario("pid_reuse", 2026, scale=0.25, hardened=False)
+    assert bad["misattributed_mass"] > 0
+    assert bad["bars"]["misattribution_reproduced"]
+    good = run_scenario("pid_reuse", 2026, scale=0.25, hardened=True)
+    assert good["misattributed_mass"] == 0
+    assert good["passed"], good["bars"]
+    assert good["identity"]["reuse_detected_total"] >= 2
+
+
+# -- chaos drill: the process.identity site is fail-open ----------------------
+
+def test_injected_identity_fault_is_contained():
+    # Chaos site process.identity: the injected error is counted, the
+    # window proceeds UNHARDENED (no invalidation fired), and nothing
+    # raises into the window loop.
+    world = {10: 100}
+    t = _tracker(world)
+    fired = []
+    t.add_invalidator("rec", fired.append)
+    t.observe_window([10])
+    faults.install(faults.FaultInjector.from_spec(
+        f"{SITE}:error", seed=42))
+    try:
+        world[10] = 999
+        assert t.observe_window([10]) == []  # degraded, not raised
+        assert t.metrics()["errors_total"] >= 1
+        assert fired == []
+    finally:
+        faults.install(None)
+    # Fault lifted: the next window detects the still-stale entry.
+    assert t.observe_window([10]) == [10]
+    assert fired == [10]
+
+
+def test_metrics_and_healthz_surface_identity():
+    from parca_agent_tpu.web import render_metrics
+
+    world = {10: 100}
+    t = _tracker(world)
+    t.observe_window([10])
+    world[10] = 999
+    t.observe_window([10])
+    text = render_metrics([], identity=t)
+    assert "parca_agent_pid_reuse_detected_total 1" in text
+    assert "parca_agent_pid_identity_checks_total" in text
+    snap = t.snapshot()
+    assert snap["enabled"] is True
+    assert snap["last_reuse"]["pid"] == 10

@@ -8,7 +8,16 @@ exercised manually / in CI: pip install --no-build-isolation --no-index .).
 """
 
 import os
-import tomllib
+
+try:
+    import tomllib  # Python 3.11+
+except ModuleNotFoundError:  # pragma: no cover - environment dependent
+    try:
+        import tomli as tomllib  # the standalone backport
+    except ModuleNotFoundError:
+        # 3.10 with no backport installed: setuptools (a build
+        # requirement of this very package) vendors tomli.
+        from setuptools._vendor import tomli as tomllib
 
 import pytest
 
