@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale bench-feed bench-regress bench-zoo trace-smoke hotspot-smoke regress-smoke fixtures golden clean install
+.PHONY: all native lint test test-live chaos fuzz bench bench-statics bench-close bench-hotspot bench-sinks bench-scale bench-feed bench-regress bench-zoo soak soak-smoke trace-smoke hotspot-smoke regress-smoke fixtures golden clean install
 
 all: native
 
@@ -37,8 +37,8 @@ test-live:
 # preflights it: the chaos-site checker is what keeps this suite's
 # coverage honest (every SITES entry exercised here, and vice versa),
 # so drift fails fast before any test runs.
-chaos: lint bench-zoo
-	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py tests/test_feed_coalesce.py tests/test_device_telemetry.py tests/test_identity.py tests/test_zoo.py -q -m chaos
+chaos: lint bench-zoo soak-smoke
+	PARCA_FAULT_SEED=42 $(PYTHON) -m pytest tests/test_chaos.py tests/test_ingest_poison.py tests/test_device_health.py tests/test_statics_store.py tests/test_trace.py tests/test_close_overlap.py tests/test_hotspots_chaos.py tests/test_sinks.py tests/test_admission.py tests/test_regression.py tests/test_feed_coalesce.py tests/test_device_telemetry.py tests/test_identity.py tests/test_zoo.py tests/test_soak.py -q -m chaos
 
 # The workload-zoo matrix (docs/robustness.md "workload zoo"): >= 6
 # seeded hostile-world scenario rows — pid reuse under tenant
@@ -49,6 +49,34 @@ chaos: lint bench-zoo
 # misattribution). Host-bound, reduced scale, one JSON line.
 bench-zoo:
 	JAX_PLATFORMS=cpu PARCA_BENCH_ZOO_CHILD=1 $(PYTHON) bench.py
+
+# Wall-clock endurance soak (docs/robustness.md "endurance matrix"):
+# ONE persistent agent (carry aggregator + streaming feeder + the full
+# registry stack) drives an endless interleave of zoo scenario
+# schedules at 1 s registry cadence, sampling RSS + per-subsystem byte
+# lanes every window. Fails on a post-warm-up RSS slope above bound,
+# any unbounded cache/counter lane, a lost window, or non-conserved
+# sample mass. Seeded and wall-bounded: SOAK_WALL / SOAK_SEED / SOAK_OUT
+# override, and both are stamped into the JSON artifact. Honors
+# PARCA_FAULTS (the soak.tick site is fail-open by contract).
+# NOTE: `python -c` instead of `-m` — the module is imported by the
+# bench_zoo package, and runpy would load it twice.
+SOAK_WALL ?= 1800
+SOAK_SEED ?= 1234
+SOAK_OUT ?= soak.json
+soak:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import sys; \
+		from parca_agent_tpu.bench_zoo.soak import main; \
+		sys.exit(main())" --wall $(SOAK_WALL) --seed $(SOAK_SEED) \
+		--out $(SOAK_OUT)
+
+# The <=90 s soak gate that rides `make chaos`: same harness, same
+# bars, 45 s wall — long enough to clear the warm-up and measure real
+# slopes, short enough for a preflight.
+soak-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import sys; \
+		from parca_agent_tpu.bench_zoo.soak import main; \
+		sys.exit(main())" --wall 45 --seed $(SOAK_SEED)
 
 # Parser mutation-fuzz gate (docs/robustness.md "ingest containment"):
 # >=500 seeded mutations per ingest parser, nothing may escape the

@@ -2786,9 +2786,15 @@ def _zoo_main() -> None:
     pid-reuse CONTROL arm, which pins the hardening off
     (PARCA_NO_PID_GENERATION semantics) and must REPRODUCE the
     cross-process misattribution, or the hardened arm's zero is
-    unfalsifiable. Host-bound by design (the zoo exercises the ingest/
-    identity/admission layers, not the device close)."""
-    from parca_agent_tpu.bench_zoo import run_scenario, run_zoo
+    unfalsifiable. Then the full endurance matrix: every scenario on
+    every close path (scalar/pipeline/streaming) at 10 s and 1 s
+    cadence with byte-identity bars across paths and digest identity
+    across cadences, plus the device-outage cross-product
+    (dispatch-hang and probe-hang must demote, run fallback windows,
+    and recover with zero lost windows). Host-bound by design (the zoo
+    exercises the ingest/identity/admission layers, not the device
+    close)."""
+    from parca_agent_tpu.bench_zoo import run_matrix, run_scenario, run_zoo
 
     seed = int(os.environ.get("PARCA_BENCH_ZOO_SEED", 1234))
     scale = float(os.environ.get("PARCA_BENCH_ZOO_SCALE", 0.5))
@@ -2824,6 +2830,39 @@ def _zoo_main() -> None:
         elif not control["passed"]:
             phase["error"] = ("pid-reuse control arm failed to reproduce "
                               "misattribution with hardening pinned off")
+        matrix = run_matrix(seed, scale=scale)
+        _progress(f"endurance matrix: {matrix['rows_passed']}"
+                  f"/{matrix['rows_total']} rows passed")
+        phase["endurance_matrix"] = {
+            "paths": matrix["paths"],
+            "cadences": matrix["cadences"],
+            "outages": matrix["outages"],
+            "rows_passed": matrix["rows_passed"],
+            "rows_total": matrix["rows_total"],
+            "rows": [
+                {k: r[k] for k in (
+                    "scenario", "path", "window_s", "outage", "windows",
+                    "windows_lost", "bars", "passed", "digest")}
+                for r in matrix["rows"]],
+            "cross": matrix["cross"],
+            "passed": matrix["passed"],
+        }
+        # Expected row count: scenarios x (paths x cadences +
+        # outages x cadences). Fewer means an axis silently dropped out.
+        want = len(matrix["schedule"]) * (
+            len(matrix["paths"]) * len(matrix["cadences"])
+            + len(matrix["outages"]) * len(matrix["cadences"]))
+        if "error" not in phase and len(matrix["rows"]) < want:
+            phase["error"] = (f"endurance matrix ran {len(matrix['rows'])} "
+                              f"rows (bar: {want})")
+        elif "error" not in phase and not matrix["passed"]:
+            bad = [f"{r['scenario']}/{r['path']}@{r['window_s']:g}s"
+                   + (f"+{r['outage']}" if r["outage"] else "")
+                   for r in matrix["rows"] if not r["passed"]]
+            bad += [f"{c['scenario']}:cross"
+                    for c in matrix["cross"]
+                    if not all(c["bars"].values())]
+            phase["error"] = "endurance matrix failed: " + ", ".join(bad)
     except Exception as e:  # noqa: BLE001 - the line must still print
         phase["error"] = repr(e)[:300]
     import jax

@@ -652,6 +652,39 @@ class DictAggregator:
         return (self.stats.get("rotations", 0)
                 + self.stats.get("invalidation_compactions", 0))
 
+    def footprint_bytes(self) -> dict:
+        """Per-lane host-memory accounting for the endurance sentinel
+        (bench_zoo/soak.py) and the /healthz ``endurance`` section:
+        in a stationary workload every lane must go flat (or sit at its
+        construction-time cap) once warm — a lane that keeps climbing is
+        the leak the soak verdict fails on. Lanes holding Python lists
+        (the per-pid location registries) are counted at a fixed
+        per-entry estimate; the soak bars care about GROWTH, not about
+        allocator-exact totals."""
+        carry = int(self._carry_h1.nbytes + self._carry_h2.nbytes
+                    + self._carry_h3.nbytes + self._carry_sid.nbytes
+                    + self._carry_w.nbytes + self._carry_starts.nbytes)
+        table = int(self._h1.nbytes + self._h2.nbytes + self._h3.nbytes
+                    + self._occ.nbytes + self._ids.nbytes
+                    + self._last_seen.nbytes)
+        id_meta = int(self._id_pid.nbytes + self._loc_off.nbytes
+                      + self._loc_flat.nbytes + self._id_h1.nbytes
+                      + self._id_h2.nbytes)
+        # ~56 B per interned key tuple entry; ~48 B per location list
+        # row across the four parallel lists; ~120 B per mapping row.
+        keys = 56 * len(self._key_to_id)
+        regs = 0
+        for reg in self._pids.values():
+            regs += 48 * len(reg.loc_address) + 120 * len(reg.mappings) \
+                + 56 * len(reg.addr_to_loc)
+        return {
+            "carry_bytes": carry,
+            "table_bytes": table,
+            "id_meta_bytes": id_meta,
+            "key_index_bytes": int(keys),
+            "pid_registry_bytes": int(regs),
+        }
+
     def registry_digest(self, pid: int, n_mappings: int | None = None,
                         n_locs: int | None = None) -> bytes | None:
         """Content digest of one pid's location registry (bounded reads

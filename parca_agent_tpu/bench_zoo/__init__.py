@@ -5,15 +5,21 @@ migration, JIT perf-map churn, fork/exec storms, deep stacks,
 kernel-heavy mixes, multi-tenant bursts), each driven through the REAL
 profiler window loop and scored against per-scenario bars. Entry
 points: ``build_schedule`` (deterministic sweep plan), ``run_scenario``
-(one matrix row), ``run_zoo`` (the whole matrix — what ``make
-bench-zoo`` runs). See docs/robustness.md's workload-zoo section.
+(one matrix row: path x cadence x optional device outage), ``run_zoo``
+(the scalar matrix — what tests pin), ``run_matrix`` (the full
+endurance matrix — what ``make bench-zoo`` runs), and ``run_soak``
+(bench_zoo/soak.py: wall-time endurance with RSS/byte-lane verdicts —
+``make soak``). See docs/robustness.md's endurance-matrix section.
 """
 
-from parca_agent_tpu.bench_zoo.runner import run_scenario, run_zoo
+from parca_agent_tpu.bench_zoo.runner import (
+    CADENCES, OUTAGES, PATHS, run_matrix, run_scenario, run_zoo)
 from parca_agent_tpu.bench_zoo.scenarios import (
     SCENARIOS, Scenario, ZooWindow, build_schedule, make_snapshot)
+from parca_agent_tpu.bench_zoo.soak import run_soak
 
 __all__ = [
-    "SCENARIOS", "Scenario", "ZooWindow", "build_schedule",
-    "make_snapshot", "run_scenario", "run_zoo",
+    "CADENCES", "OUTAGES", "PATHS", "SCENARIOS", "Scenario", "ZooWindow",
+    "build_schedule", "make_snapshot", "run_matrix", "run_scenario",
+    "run_soak", "run_zoo",
 ]

@@ -11,11 +11,34 @@ The scenario only supplies the WORLD: snapshots, procfs files, and
 per-pid starttimes, mutated window by window exactly as a hostile host
 would mutate them under the agent.
 
+Three axes beyond the scenario itself (``run_matrix``):
+
+* **path** — the same windows ride the scalar close path (``scalar``),
+  the fast-encode pipeline (``pipeline``: window_counts + vectorized
+  encoder + encode worker), or a streaming feeder with the carry cache
+  (``streaming``: chunked ``feed`` + packed close over a carry-enabled
+  dict). The fast arms must ship byte-identical pprof sequences and all
+  three must conserve the same per-window mass.
+* **cadence** — every row re-runs at a sub-second window
+  (``window_s=1.0``). Scenario knobs are authored at the reference
+  10 s window, so the runner scales them to their wall-time-equivalent
+  values (:func:`_wall_equivalent`) and the registries' own
+  window_clock conversion restores the exact per-window numbers; a
+  compensated run therefore must make identical per-window decisions,
+  and the scalar digest must be bit-identical across cadences. That
+  round trip is what the cadence bar pins.
+* **outage** — scalar rows re-run with a fallback aggregator and a
+  DeviceHealthRegistry while a mid-run ``device.dispatch`` hang (or a
+  hung bring-up ``device.probe``) is injected. The row must degrade
+  through the health ladder and recover (shadow window -> healthy)
+  with zero lost windows while the hostile workload keeps running.
+
 Scoring: every row carries the base bars (windows_lost == 0, sample
 mass conserved end to end, close-latency ceiling) plus the scenario's
 own (reuse detected, abuser quarantined, byte identity, ...). A row
 passes only if every bar holds; ``run_zoo`` is the matrix sweep
-``make bench-zoo`` and tests/test_zoo.py drive.
+``make bench-zoo`` and tests/test_zoo.py drive, ``run_matrix`` is the
+full path x cadence x outage cross-product.
 """
 
 from __future__ import annotations
@@ -29,21 +52,107 @@ import numpy as np
 
 from parca_agent_tpu.aggregator.dict import DictAggregator
 from parca_agent_tpu.bench_zoo.scenarios import (
-    SCENARIOS, Scenario, ZooWindow, build_schedule)
+    SCENARIOS, WINDOW_NS, Scenario, ZooWindow, _mapping, build_schedule,
+    make_snapshot)
 from parca_agent_tpu.process.identity import ProcessIdentityTracker
 from parca_agent_tpu.profiler.cpu import CPUProfiler
 from parca_agent_tpu.runtime.admission import (
     AdmissionController, TenantResolver)
+from parca_agent_tpu.runtime.device_health import (
+    DeviceHealthRegistry, STATE_HEALTHY, STATE_PROBING)
 from parca_agent_tpu.runtime.quarantine import QuarantineRegistry
+from parca_agent_tpu.runtime.window_clock import (
+    REFERENCE_WINDOW_S, check_window_s)
 from parca_agent_tpu.symbolize.ksym import KsymCache
 from parca_agent_tpu.symbolize.perfmap import PerfMapCache
 from parca_agent_tpu.symbolize.symbolizer import Symbolizer
+from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.vfs import FakeFS
 
 # Per-scenario close-latency ceiling (seconds). The zoo runs tiny
 # windows on the scalar path; a close that takes longer than this is a
 # regression even on a loaded CI box.
 DEFAULT_CLOSE_CEILING_S = 2.0
+
+# The three close paths every scenario must survive.
+PATHS = ("scalar", "pipeline", "streaming")
+
+# Reference cadence plus the 10x sub-second re-run.
+CADENCES = (REFERENCE_WINDOW_S, 1.0)
+
+# Mid-run device faults (faults.py SITES) each scenario is crossed with.
+OUTAGES = ("dispatch", "probe")
+
+# Outage-row device watchdog: the injected dispatch hang must overrun
+# it, a real zoo aggregate (milliseconds on these snapshots, once the
+# per-shape kernel compiles are warmed) must not — even when a gen-2
+# GC pause or ambient suite contention stalls the dispatch thread for
+# a few hundred ms, so keep ~100x headroom on the real side and ~4x
+# on the injected side.
+_OUTAGE_DEVICE_TIMEOUT_S = 0.5
+_OUTAGE_HANG_MS = 2000
+
+# Idle drain windows appended to outage rows: production does not stop
+# polling after an outage, so the row gets the same courtesy — enough
+# extra windows for the ladder to absorb one spurious re-demote (a
+# wall-contention stall can make a warm ~ms dispatch overrun the
+# watchdog and burn a shadow attempt) and still prove recovery.
+_OUTAGE_DRAIN_WINDOWS = 8
+
+# The scenario knob names AdmissionController/QuarantineRegistry treat
+# as wall-time window counts vs per-reference-window rates, with the
+# constructor defaults repeated here: wall-equivalence must scale the
+# DEFAULTED values too, or a compensated sub-second run would make
+# different per-window decisions than the reference run.
+_ADMISSION_DEFAULTS = {
+    "quota_samples": 0, "quota_pids": 0, "burst_windows": 3,
+    "degrade_after": 2, "escalate_after": 3, "recover_windows": 3,
+    "storm_new_pids": 0,
+}
+_ADMISSION_WINDOW_KNOBS = ("burst_windows", "degrade_after",
+                           "escalate_after", "recover_windows")
+_ADMISSION_RATE_KNOBS = ("quota_samples", "quota_pids", "storm_new_pids")
+_QUARANTINE_DEFAULTS = {
+    "quarantine_windows": 3, "max_quarantine_windows": 60,
+    "probation_windows": 2, "healthy_after_windows": 6,
+}
+
+
+def _wall_equivalent(cfg: dict, window_s: float) -> tuple[dict, dict]:
+    """Scale a scenario's reference-cadence knobs to wall-time-equivalent
+    values at ``window_s``. Window-count knobs shrink by window_s/10 and
+    rate knobs grow by 10/window_s, so the registries' own window_clock
+    conversion restores the exact per-window numbers — the compensated
+    run is the SAME run at a different tick rate, which is exactly what
+    the cadence-invariance bar needs to hold. Per-event knobs
+    (max_strikes, escalate trip counts) pass through untouched."""
+    scale_w = window_s / REFERENCE_WINDOW_S
+    adm = dict(_ADMISSION_DEFAULTS)
+    adm.update(cfg.get("admission", {}))
+    for k in _ADMISSION_WINDOW_KNOBS:
+        adm[k] = adm[k] * scale_w
+    for k in _ADMISSION_RATE_KNOBS:
+        adm[k] = adm[k] / scale_w
+    adm["window_s"] = window_s
+    qua = dict(_QUARANTINE_DEFAULTS)
+    qua.update(cfg.get("quarantine", {}))
+    for k in _QUARANTINE_DEFAULTS:
+        qua[k] = qua[k] * scale_w
+    qua["window_s"] = window_s
+    return adm, qua
+
+
+class _FakeClock:
+    """Deterministic seconds source for the outage rows' probe deadline:
+    the runner advances it by window_s per iteration, so a hung probe
+    overruns its deadline on the WINDOW clock even though zoo windows
+    execute in microseconds of wall time."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
 
 
 class _ZooSource:
@@ -78,25 +187,123 @@ class _ZooWriter:
         self.shipped: list[tuple[int, dict, bytes]] = []
 
     def write(self, labels: dict, blob: bytes) -> None:
-        self.shipped.append((self._source.current, dict(labels), blob))
+        # bytes() copy: the fast-encode arms ship views into the
+        # encoder's reusable buffers, which later windows overwrite.
+        self.shipped.append((self._source.current, dict(labels),
+                             bytes(blob)))
 
 
 class _RecordingAggregator:
     """Transparent DictAggregator proxy that keeps each window's
     pre-ladder profile objects for scoring (the profiler ships the same
-    objects, so symbolization results are visible here too)."""
+    objects, so symbolization results are visible here too). Entries are
+    also tagged with the window the call was DISPATCHED for: an
+    abandoned (hung) device aggregate completes late, after the source
+    advanced, and must not be misattributed to a later window."""
 
-    def __init__(self, inner: DictAggregator):
+    def __init__(self, inner: DictAggregator, source: _ZooSource | None = None):
         self._inner = inner
+        self._zoo_source = source
         self.windows: list[list] = []
+        self.tagged: list[tuple[int, list]] = []
 
     def aggregate(self, snapshot):
+        w = self._zoo_source.current if self._zoo_source is not None else -1
         profiles = self._inner.aggregate(snapshot)
         self.windows.append(list(profiles))
+        self.tagged.append((w, list(profiles)))
         return profiles
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+
+class _RecordingDict(DictAggregator):
+    """DictAggregator whose one-shot closes record per-window mass. The
+    fast arms never materialize PidProfile objects, so this tap (plus
+    the streaming feeder's) is where their mass-conservation bar reads
+    from. A real subclass, not a proxy: the WindowEncoder reads
+    aggregator internals directly."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.zoo_source: _ZooSource | None = None
+        self.mass_by_window: dict[int, int] = {}
+
+    def window_counts(self, snapshot, hashes=None):
+        counts = super().window_counts(snapshot, hashes)
+        w = self.zoo_source.current if self.zoo_source is not None else -1
+        self.mass_by_window[w] = (self.mass_by_window.get(w, 0)
+                                  + int(np.asarray(counts).sum()))
+        return counts
+
+
+class _ZooStreamFeeder:
+    """Minimal streaming feeder for the zoo's streaming-carry arm: each
+    polled snapshot is fed to the (carry-enabled) aggregator in
+    drain-sized chunks — the carry cache and the coalesce fold see a
+    multi-drain window, like the production tee does — and the window
+    closes packed at take_window_if_complete. The chunked path is a
+    chaos site (``zoo.path``): an injected fault is counted
+    (path_fallbacks), the open window is discarded, and None hands the
+    window to the profiler's one-shot close instead — same mass, never
+    a lost window."""
+
+    CHUNKS = 4
+
+    def __init__(self, agg: DictAggregator, source: _ZooSource):
+        self._agg = agg
+        self._source = source
+        self.mass_by_window: dict[int, int] = {}
+        self.stats = {"windows_streamed": 0, "path_fallbacks": 0,
+                      "last_window_feed_s": 0.0}
+
+    def device_blocked(self) -> bool:
+        return False
+
+    def _chunk_bounds(self, pids) -> list[int]:
+        """Drain boundaries that never split a pid's row run: per-pid
+        location registration is batch-local (np.unique order inside
+        each feed), so a pid fed across two drains would register its
+        locations in a different order than the one-shot close and
+        break the cross-arm byte-identity bar. Zoo snapshots group rows
+        by pid; if a pid ever appeared in two runs the window degrades
+        to a single drain rather than ship divergent bytes."""
+        n = len(pids)
+        edges = [i for i in range(1, n) if pids[i] != pids[i - 1]]
+        if len(edges) + 1 != len(set(pids)):
+            return [0, n]
+        bounds = [0]
+        for k in range(1, self.CHUNKS):
+            target = round(k * n / self.CHUNKS)
+            best = min(edges, key=lambda e: abs(e - target), default=None)
+            if best is not None and best > bounds[-1]:
+                bounds.append(best)
+        bounds.append(n)
+        return bounds
+
+    def take_window_if_complete(self, snapshot):
+        t0 = time.perf_counter()
+        n = int(np.asarray(snapshot.counts).shape[0])
+        if n == 0:
+            return None  # nothing streamed: the one-shot close owns it
+        try:
+            faults.inject("zoo.path")
+            bounds = self._chunk_bounds(
+                np.asarray(snapshot.pids).tolist())
+            for lo, hi in zip(bounds, bounds[1:]):
+                self._agg.feed(snapshot, lo=lo, hi=hi)
+            counts = self._agg.close_window(copy=True)
+        except Exception:  # noqa: BLE001 - fail-open: one-shot close path
+            self.stats["path_fallbacks"] += 1
+            self._agg.discard_open_window()
+            return None
+        self.stats["windows_streamed"] += 1
+        self.stats["last_window_feed_s"] = time.perf_counter() - t0
+        w = self._source.current
+        self.mass_by_window[w] = (self.mass_by_window.get(w, 0)
+                                  + int(np.asarray(counts).sum()))
+        return counts
 
 
 @dataclasses.dataclass
@@ -135,62 +342,242 @@ def _digest(ctx: RunContext) -> str:
     return h.hexdigest()
 
 
+def _shipped_seq(shipped) -> list[tuple[str, str]]:
+    """Cross-arm byte-identity handle: the ordered (pid, blob sha)
+    sequence. Window indices are deliberately excluded — the encode
+    pipeline ships asynchronously, so the writer's window tag can lag a
+    ship, but FIFO ordering makes the sequence itself comparable."""
+    return [(labels.get("pid", ""), hashlib.sha256(blob).hexdigest())
+            for _w, labels, blob in shipped]
+
+
 def run_scenario(scenario, seed: int, scale: float = 1.0,
-                 hardened: bool | None = None) -> dict:
+                 hardened: bool | None = None, path: str = "scalar",
+                 window_s: float = REFERENCE_WINDOW_S,
+                 outage: str | None = None) -> dict:
     """One matrix row: build the scenario's windows, drive them through
-    the real profiler loop, and score against the bars. ``hardened``
-    None follows PARCA_NO_PID_GENERATION (the control-arm pin)."""
+    the real profiler loop on the requested close ``path`` at the
+    requested cadence, and score against the bars. ``hardened`` None
+    follows PARCA_NO_PID_GENERATION (the control-arm pin); ``outage``
+    (scalar path only) injects a mid-run device fault and scores the
+    health ladder's degrade/recover arc."""
     scn: Scenario = (SCENARIOS[scenario]()
                      if isinstance(scenario, str) else scenario)
     if hardened is None:
         hardened = os.environ.get("PARCA_NO_PID_GENERATION", "") != "1"
+    if path not in PATHS:
+        raise ValueError(f"unknown zoo path {path!r} (want one of {PATHS})")
+    if outage is not None and outage not in OUTAGES:
+        raise ValueError(f"unknown outage {outage!r} "
+                         f"(want one of {OUTAGES})")
+    if outage is not None and path != "scalar":
+        raise ValueError("outage rows run the scalar close path (the "
+                         "guarded device dispatch)")
+    check_window_s(window_s)
     windows = scn.build(seed, scale)
+    n_scenario_windows = len(windows)
+    if outage is not None and windows:
+        # Idle drains (see _OUTAGE_DRAIN_WINDOWS): one sample per window
+        # from a pid no scenario uses, so mass stays live end to end
+        # without colliding with any scenario pid's identity.
+        drain_pid = 1 << 22
+        maps = {drain_pid: [_mapping(0x400000, 0x500000, "/app/idle")]}
+        last_ns = windows[-1].snapshot.time_ns
+        for d in range(_OUTAGE_DRAIN_WINDOWS):
+            snap = make_snapshot(
+                [(drain_pid, 1, 1, [0x400010], [])], maps,
+                last_ns + (d + 1) * WINDOW_NS)
+            windows.append(ZooWindow(snap, starttimes={drain_pid: 1}))
     cfg = scn.config(scale)
+    adm_kwargs, qua_kwargs = _wall_equivalent(cfg, window_s)
 
     fs = FakeFS()
     world: dict[int, int] = {}
     resolver = TenantResolver(fs=fs)
-    admission = AdmissionController(resolver, **cfg.get("admission", {}))
-    quarantine = QuarantineRegistry(**cfg.get("quarantine", {}))
+    admission = AdmissionController(resolver, **adm_kwargs)
+    quarantine = QuarantineRegistry(**qua_kwargs)
     perf = PerfMapCache(fs=fs, churn_budget=int(cfg.get("churn_budget", 8)))
     ksym = None
     if cfg.get("kallsyms"):
         fs.put("/proc/kallsyms", cfg["kallsyms"])
         ksym = KsymCache(fs=fs)
-    symbolizer = Symbolizer(ksym=ksym, perf=perf,
-                            quarantine=quarantine, admission=admission)
-    inner = DictAggregator(capacity=1 << 14)
-    agg = _RecordingAggregator(inner)
     identity = ProcessIdentityTracker(
         starttime_of=world.__getitem__, enabled=hardened)
     # The same invalidator set cli.py registers: every bare-pid cache
     # drops the dead generation's state on a starttime mismatch.
-    identity.add_invalidator("aggregator", inner.invalidate_pid)
     identity.add_invalidator("quarantine", quarantine.forget_pid)
     identity.add_invalidator("tenant", resolver.forget)
     identity.add_invalidator("perfmap", perf.evict)
 
     source = _ZooSource(windows, fs, world)
     writer = _ZooWriter(source)
-    profiler = CPUProfiler(
-        source, agg, symbolizer=symbolizer, profile_writer=writer,
-        quarantine=quarantine, admission=admission, identity=identity)
 
+    agg = None            # scalar arms: recording proxy over the dict
+    fastagg = None        # fast arms: recording DictAggregator subclass
+    feeder = None
+    fb = None
+    health = None
+    fake_clock = None
+    profiler_kwargs: dict = {}
+    if path == "scalar":
+        symbolizer = Symbolizer(ksym=ksym, perf=perf,
+                                quarantine=quarantine, admission=admission)
+        inner = DictAggregator(capacity=1 << 14)
+        agg = _RecordingAggregator(inner, source=source)
+        identity.add_invalidator("aggregator", inner.invalidate_pid)
+        scale_w = window_s / REFERENCE_WINDOW_S
+        if outage is not None:
+            # The ladder under test: a CPU fallback dict plus a health
+            # registry whose cooldowns are wall-equivalent one window.
+            fb_inner = DictAggregator(capacity=1 << 14)
+            fb = _RecordingAggregator(fb_inner, source=source)
+            identity.add_invalidator("fallback-aggregator",
+                                     fb_inner.invalidate_pid)
+            if outage == "dispatch":
+                # Cooldown of two wall-equivalent windows: the arc must
+                # visibly pass through a planned fallback window before
+                # the shadow gate, not demote-and-promote in one tick.
+                health = DeviceHealthRegistry(
+                    probe=None, promote_after=0,
+                    cooldown_windows=2 * scale_w,
+                    max_cooldown_windows=8 * scale_w,
+                    start_state=STATE_HEALTHY, window_s=window_s)
+            else:  # probe: bring-up hangs, deadline trips on the window
+                #        clock, the re-probe succeeds, shadow promotes.
+                fake_clock = _FakeClock()
+                health = DeviceHealthRegistry(
+                    probe=lambda: (True, "ok"), probe_timeout_s=5.0,
+                    probe_deadline_s=0.05, promote_after=1,
+                    cooldown_windows=1 * scale_w,
+                    max_cooldown_windows=4 * scale_w,
+                    start_state=STATE_PROBING, clock=fake_clock,
+                    window_s=window_s)
+            profiler_kwargs = {
+                "fallback_aggregator": fb,
+                "device_health": health,
+                "device_timeout_s": _OUTAGE_DEVICE_TIMEOUT_S,
+            }
+        profiler = CPUProfiler(
+            source, agg, symbolizer=symbolizer, profile_writer=writer,
+            quarantine=quarantine, admission=admission, identity=identity,
+            **profiler_kwargs)
+    else:
+        # Fast arms ship unsymbolized (the fast-encode contract); the
+        # streaming arm additionally exercises the carry cache across
+        # chunked drains. No fallback: an arm that cannot close its
+        # window on its own path has failed the row.
+        fastagg = _RecordingDict(capacity=1 << 14,
+                                 carry=(path == "streaming"))
+        fastagg.zoo_source = source
+        identity.add_invalidator("aggregator", fastagg.invalidate_pid)
+        if path == "streaming":
+            feeder = _ZooStreamFeeder(fastagg, source)
+        profiler = CPUProfiler(
+            source, fastagg, profile_writer=writer,
+            quarantine=quarantine, admission=admission, identity=identity,
+            fast_encode=True, streaming_feeder=feeder,
+            encode_pipeline=(path == "pipeline"))
+
+    if outage is not None and windows:
+        # Outage rows run every device window under a tight watchdog
+        # (_OUTAGE_DEVICE_TIMEOUT_S): warm every window shape's kernel
+        # compile on a throwaway dict first — the jit cache is keyed
+        # per snapshot shape AND per dict capacity, so a cold process
+        # would read a mid-arc compile (0.3-0.5 s) as an unplanned
+        # hang and burn the recovery arc's shadow window on it.
+        warm = DictAggregator(capacity=1 << 14)
+        for w in windows:
+            warm.window_counts(w.snapshot)
+
+    hang_at = (max(1, n_scenario_windows // 2)
+               if outage == "dispatch" else None)
+    prior_injector = faults.get()
     close_lat: list[float] = []
     t0 = time.perf_counter()
-    while profiler.run_iteration():
-        close_lat.append(profiler.metrics.last_aggregate_duration_s)
-    wall_s = time.perf_counter() - t0
+    try:
+        if outage == "probe":
+            faults.install(faults.FaultInjector.from_spec(
+                f"device.probe:hang:ms={_OUTAGE_HANG_MS},count=1",
+                seed=seed))
+        if health is not None:
+            health.start()
+        it = 0
+        while True:
+            if hang_at is not None and it == hang_at:
+                faults.install(faults.FaultInjector.from_spec(
+                    f"device.dispatch:hang:ms={_OUTAGE_HANG_MS},count=1",
+                    seed=seed))
+            if fake_clock is not None:
+                fake_clock.t += window_s
+            if not profiler.run_iteration():
+                break
+            close_lat.append(profiler.metrics.last_aggregate_duration_s)
+            if hang_at is not None and it == hang_at:
+                faults.install(prior_injector)
+            if outage is not None:
+                # Windows run back-to-back here, but production gets a
+                # full window of wall time between polls for an
+                # abandoned dispatch to land. Grant the same, or the
+                # inflight gate forces every remaining window to the
+                # fallback and a pending shadow starves forever.
+                done = getattr(profiler, "_device_inflight", None)
+                if done is not None:
+                    done.wait(2 * _OUTAGE_HANG_MS / 1000.0)
+            if outage == "probe":
+                # A launched re-probe delivers on its own thread; bound
+                # the race so the promotion arc lands on schedule.
+                deadline = time.monotonic() + 2.0
+                while (health._probe_started_at is not None
+                       and time.monotonic() < deadline):
+                    time.sleep(0.001)
+            it += 1
+        wall_s = time.perf_counter() - t0
+    finally:
+        faults.install(prior_injector)
+        if profiler._pipeline is not None:
+            # The manual loop bypasses run()'s teardown: drain the
+            # encode worker so every closed window is shipped.
+            profiler._pipeline.close()
+
+    # -- assemble the scored substance per path -----------------------------
+    if path == "scalar" and outage is not None:
+        # Merge device and fallback recorders per window, preferring the
+        # fallback entry: on hang and shadow windows the CPU result is
+        # what shipped, and the abandoned device aggregate may complete
+        # late (its entry is tagged with the window it was dispatched
+        # for, not the window it finished in).
+        by_w: dict[int, list] = {}
+        for w, profs in agg.tagged:
+            by_w.setdefault(w, profs)
+        for w, profs in fb.tagged:
+            by_w[w] = profs
+        profiles_by_window = [by_w.get(i, []) for i in range(len(windows))]
+        windows_closed = len(by_w)
+        mass_by_window = [sum(int(p.total()) for p in profs)
+                          for profs in profiles_by_window]
+    elif path == "scalar":
+        profiles_by_window = agg.windows
+        windows_closed = len(agg.windows)
+        mass_by_window = [sum(int(p.total()) for p in profs)
+                          for profs in profiles_by_window]
+    else:
+        profiles_by_window = []
+        masses = dict(fastagg.mass_by_window)
+        if feeder is not None:
+            for w, m in feeder.mass_by_window.items():
+                masses[w] = masses.get(w, 0) + m
+        windows_closed = len(masses)
+        mass_by_window = [masses.get(i, 0) for i in range(len(windows))]
 
     ctx = RunContext(
-        profiles_by_window=agg.windows, shipped=writer.shipped,
-        truth=scn.truth, aggregator=inner, identity=identity,
-        admission=admission, quarantine=quarantine, resolver=resolver,
-        perf=perf)
+        profiles_by_window=profiles_by_window, shipped=writer.shipped,
+        truth=scn.truth,
+        aggregator=(agg._inner if agg is not None else fastagg),
+        identity=identity, admission=admission, quarantine=quarantine,
+        resolver=resolver, perf=perf)
 
     samples_fed = int(sum(int(zw.snapshot.counts.sum()) for zw in windows))
-    samples_shipped = int(sum(p.total() for profs in agg.windows
-                              for p in profs))
+    samples_shipped = int(sum(mass_by_window))
     ceiling = float(cfg.get("close_latency_ceiling_s",
                             DEFAULT_CLOSE_CEILING_S))
     outcome = {
@@ -200,13 +587,18 @@ def run_scenario(scenario, seed: int, scale: float = 1.0,
         "seed": int(seed),
         "scale": float(scale),
         "hardened": bool(hardened),
+        "path": path,
+        "window_s": float(window_s),
+        "outage": outage,
         "windows": len(windows),
         "degraded_builds": int(scn.truth.get("degraded_builds", 0)),
         "windows_lost": int(profiler.metrics.errors_total),
-        "windows_closed": len(agg.windows),
+        "windows_closed": windows_closed,
         "profiles_written": int(profiler.metrics.profiles_written),
         "samples_fed": samples_fed,
         "samples_shipped": samples_shipped,
+        "mass_by_window": mass_by_window,
+        "shipped_seq": _shipped_seq(writer.shipped),
         "close_latency_max_s": max(close_lat, default=0.0),
         "close_latency_ceiling_s": ceiling,
         "wall_s": wall_s,
@@ -216,6 +608,11 @@ def run_scenario(scenario, seed: int, scale: float = 1.0,
         "perfmap": dict(perf.stats),
         "tenant_resolver": dict(resolver.stats),
     }
+    if feeder is not None:
+        outcome["streaming"] = dict(feeder.stats)
+    if health is not None:
+        outcome["device_health"] = dict(health.stats)
+        outcome["device_state"] = health.state
     bars = {
         "windows_lost_zero": outcome["windows_lost"] == 0,
         "every_window_closed": outcome["windows_closed"] == len(windows),
@@ -223,7 +620,19 @@ def run_scenario(scenario, seed: int, scale: float = 1.0,
         "close_latency_ceiling":
             outcome["close_latency_max_s"] <= ceiling,
     }
-    bars.update(scn.check(outcome, ctx))
+    if path == "scalar" and outage is None:
+        # Scenario-specific truths inspect scalar profile objects and
+        # assume no mid-run backend churn; path/outage rows are scored
+        # on the base + axis bars above/below instead.
+        bars.update(scn.check(outcome, ctx))
+    if health is not None:
+        hung = (health.stats["hangs_total"] if outage == "dispatch"
+                else health.stats["probes_hung"])
+        bars["outage_injected"] = hung >= 1
+        bars["outage_demoted"] = health.stats["demotions_total"] >= 1 \
+            and health.stats["fallback_windows_total"] >= 1
+        bars["outage_recovered"] = health.state == STATE_HEALTHY \
+            and health.stats["promotions_total"] >= 1
     outcome["bars"] = bars
     outcome["passed"] = all(bars.values())
     outcome["digest"] = _digest(ctx)
@@ -232,8 +641,8 @@ def run_scenario(scenario, seed: int, scale: float = 1.0,
 
 def run_zoo(seed: int, scale: float = 1.0, names=None,
             hardened: bool | None = None) -> dict:
-    """The full matrix sweep: a deterministic schedule of scenario rows,
-    each scored through the real window loop."""
+    """The scalar matrix sweep: a deterministic schedule of scenario
+    rows, each scored through the real window loop."""
     schedule = build_schedule(seed, names)
     rows = [run_scenario(e["scenario"], e["seed"], scale=scale,
                          hardened=hardened) for e in schedule]
@@ -245,4 +654,66 @@ def run_zoo(seed: int, scale: float = 1.0, names=None,
         "scenarios_passed": sum(r["passed"] for r in rows),
         "scenarios_total": len(rows),
         "passed": bool(rows) and all(r["passed"] for r in rows),
+    }
+
+
+def run_matrix(seed: int, scale: float = 1.0, names=None,
+               cadences=CADENCES, outages=OUTAGES) -> dict:
+    """The full endurance matrix: every scheduled scenario runs as a
+    three-arm row (scalar / pipeline / streaming-carry) at every
+    cadence, plus the device-outage cross-product, with the cross-arm
+    bars (pprof byte identity between the fast arms, per-window mass
+    identity across all three, scalar digest identity across cadences)
+    scored per scenario."""
+    schedule = build_schedule(seed, names)
+    rows: list[dict] = []
+    cross: list[dict] = []
+    for e in schedule:
+        per_arm: dict[tuple[str, float], dict] = {}
+        for w in cadences:
+            for path in PATHS:
+                row = run_scenario(e["scenario"], e["seed"], scale=scale,
+                                   path=path, window_s=w)
+                per_arm[(path, w)] = row
+                rows.append(row)
+        for mode in outages:
+            for w in cadences:
+                rows.append(run_scenario(e["scenario"], e["seed"],
+                                         scale=scale, path="scalar",
+                                         window_s=w, outage=mode))
+        scalar_digests = {w: per_arm[("scalar", w)]["digest"]
+                          for w in cadences}
+        bars = {}
+        for w in cadences:
+            sc = per_arm[("scalar", w)]
+            pi = per_arm[("pipeline", w)]
+            st = per_arm[("streaming", w)]
+            bars[f"path_bytes_identical@{w:g}s"] = \
+                bool(pi["shipped_seq"]) \
+                and pi["shipped_seq"] == st["shipped_seq"]
+            bars[f"path_mass_identical@{w:g}s"] = (
+                sc["mass_by_window"] == pi["mass_by_window"]
+                == st["mass_by_window"])
+        bars["cadence_digest_identical"] = \
+            len(set(scalar_digests.values())) == 1
+        cross.append({
+            "scenario": e["scenario"], "seed": e["seed"], "bars": bars,
+            "scalar_digests": {f"{w:g}": d
+                               for w, d in scalar_digests.items()},
+            "passed": all(bars.values()),
+        })
+    passed = (bool(rows) and all(r["passed"] for r in rows)
+              and all(c["passed"] for c in cross))
+    return {
+        "seed": int(seed),
+        "scale": float(scale),
+        "paths": list(PATHS),
+        "cadences": [float(w) for w in cadences],
+        "outages": list(outages),
+        "schedule": schedule,
+        "rows": rows,
+        "cross": cross,
+        "rows_passed": sum(r["passed"] for r in rows),
+        "rows_total": len(rows),
+        "passed": passed,
     }

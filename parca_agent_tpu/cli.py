@@ -517,6 +517,35 @@ def run(argv=None) -> int:
     log.info("starting parca-agent-tpu", version=binfo.display(),
              python=binfo.python)
 
+    # -- window cadence (docs/perf.md "sub-second windows") ------------------
+    # Window-denominated registry knobs are authored against the 10 s
+    # reference window and converted through runtime/window_clock, so
+    # semantics survive any cadence — but the flag itself must be a real
+    # duration, and sub-window rollup buckets can only alias the window
+    # clock (a bucket can't seal more often than a window closes).
+    if args.profiling_duration <= 0:
+        raise SystemExit("--profiling-duration must be > 0")
+    if args.statics_snapshot_interval < 1:
+        raise SystemExit("--statics-snapshot-interval must be >= 1")
+    if args.profiling_duration < 0.5:
+        log.warn("sub-0.5s windows: per-window fixed costs (device "
+                 "dispatch, registry ticks, encode prep) dominate below "
+                 "~0.5s and the profiler may not keep real-time; see "
+                 "docs/perf.md", window_s=args.profiling_duration)
+    try:
+        rollup_min = min(float(x) for x in
+                         args.hotspot_rollup_intervals.split(",")
+                         if x.strip())
+    except ValueError:
+        rollup_min = None  # the hotspot block rejects it with context
+    for flag, v in (("--regression-interval", args.regression_interval),
+                    ("--hotspot-rollup-intervals", rollup_min)):
+        if v is not None and 0 < v < args.profiling_duration:
+            log.warn("rollup interval is shorter than one window; "
+                     "buckets can seal at most once per window close",
+                     flag=flag, interval_s=v,
+                     window_s=args.profiling_duration)
+
     # -- fault injection (chaos testing) ------------------------------------
     import os as _os
 
@@ -670,6 +699,7 @@ def run(argv=None) -> int:
         fallback = CPUAggregator()
     elif args.aggregator in ("dict", "dict+cm"):
         from parca_agent_tpu.aggregator.dict import DictAggregator
+        from parca_agent_tpu.runtime.window_clock import windows_for
 
         # Both modes share the implementation; "dict" fails fast at
         # capacity (fixed-population benchmarking), "dict+cm" degrades to
@@ -679,6 +709,10 @@ def run(argv=None) -> int:
         aggregator = DictAggregator(
             capacity=args.aggregator_capacity,
             overflow="sketch" if args.aggregator == "dict+cm" else "raise",
+            # Cold-stack rotation age is authored in 10 s reference
+            # windows; hold wall-clock residency constant across
+            # cadences so 1 s windows don't evict 10x faster.
+            rotate_min_age=windows_for(6, args.profiling_duration),
             carry=args.streaming_window and not args.no_feed_carry)
         fallback = CPUAggregator()
     else:
@@ -707,7 +741,8 @@ def run(argv=None) -> int:
         device_health = DeviceHealthRegistry(
             probe=probe,
             probe_timeout_s=args.device_probe_timeout,
-            promote_after=args.device_promote_after)
+            promote_after=args.device_promote_after,
+            window_s=args.profiling_duration)
         device_health.start()
 
     # -- multi-tenant admission (docs/robustness.md) -------------------------
@@ -759,7 +794,8 @@ def run(argv=None) -> int:
                 backlog=args.overload_backlog,
                 shed_after=args.overload_shed_after,
                 recover_after=args.overload_recover_after),
-            top_n=args.tenant_top_n)
+            top_n=args.tenant_top_n,
+            window_s=args.profiling_duration)
         if hasattr(aggregator, "set_shard_router"):
             # Tenant-keyed home shards: one tenant's registry growth
             # parallelizes across chips by tenant instead of spraying
@@ -930,7 +966,8 @@ def run(argv=None) -> int:
         quarantine = QuarantineRegistry(
             max_strikes=args.quarantine_max_strikes,
             quarantine_windows=args.quarantine_windows,
-            deadline_s=args.quarantine_pid_deadline or None)
+            deadline_s=args.quarantine_pid_deadline or None,
+            window_s=args.profiling_duration)
         if tenant_resolver is not None:
             # Per-tenant eviction scoping at the tracked-pid cap: a
             # pid-churn storm from one tenant recycles its own slots

@@ -76,6 +76,12 @@ from parca_agent_tpu.runtime.quarantine import (
     LEVEL_FULL,
     LEVEL_SCALAR,
 )
+from parca_agent_tpu.runtime.window_clock import (
+    REFERENCE_WINDOW_S,
+    check_window_s,
+    per_window,
+    windows_for,
+)
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 from parca_agent_tpu.utils.poison import read_bounded
@@ -294,24 +300,38 @@ class AdmissionController:
                  burst_windows: int = 3, degrade_after: int = 2,
                  escalate_after: int = 3, recover_windows: int = 3,
                  overload: OverloadPolicy | None = None,
-                 top_n: int = 10, storm_new_pids: int = 0):
+                 top_n: int = 10, storm_new_pids: int = 0,
+                 window_s: float = REFERENCE_WINDOW_S):
         if quota_samples < 0 or quota_pids < 0:
             raise ValueError("tenant quotas must be >= 0")
         self.resolver = resolver
-        self._quota_samples = int(quota_samples)
-        self._quota_pids = int(quota_pids)
-        self._burst = max(1, int(burst_windows))
-        self._degrade_after = max(1, int(degrade_after))
-        self._escalate_after = max(1, int(escalate_after))
-        self._recover = max(1, int(recover_windows))
+        # Every knob is expressed at the reference 10 s window and
+        # converted here (runtime/window_clock.py): quotas are
+        # per-window REFILLS (same samples/second at any cadence),
+        # window-count knobs are wall-time commitments (same seconds of
+        # patience at any cadence). At the reference cadence both
+        # conversions are exact identities.
+        self._window_s = check_window_s(window_s)
+        self._quota_samples = per_window(quota_samples, window_s)
+        self._quota_pids = per_window(quota_pids, window_s)
+        self._burst = windows_for(burst_windows, window_s)
+        self._degrade_after = windows_for(degrade_after, window_s)
+        self._escalate_after = windows_for(escalate_after, window_s)
+        self._recover = windows_for(recover_windows, window_s)
+        self._idle_forget = windows_for(self._IDLE_FORGET_WINDOWS,
+                                        window_s)
         self._overload = overload or OverloadPolicy()
+        self._shed_after = windows_for(self._overload.shed_after, window_s)
+        self._recover_after = windows_for(self._overload.recover_after,
+                                          window_s)
         self._top_n = max(1, int(top_n))
         # Fork/exec-storm detection: a window introducing more than
         # `storm_new_pids` never-seen pids (0 = off) degrades via the
         # governor's shed step — discovery cost (maps parses, unwind
         # builds, registry inserts) is per NEW pid, paid before any
         # quota sees a sample.
-        self._storm_threshold = max(0, int(storm_new_pids))
+        self._storm_threshold = per_window(
+            max(0.0, float(storm_new_pids)), window_s)
         self._seen_pids: set[int] = set()   # guarded-by: _lock
         self._storm_new_window = 0          # guarded-by: _lock
         self._lock = threading.Lock()
@@ -422,7 +442,7 @@ class AdmissionController:
                 drop = []
                 for tenant, st in self._tenants.items():
                     self._tick_tenant_locked(tenant, st)
-                    if st.idle_windows >= self._IDLE_FORGET_WINDOWS \
+                    if st.idle_windows >= self._idle_forget \
                             and st.level == LEVEL_FULL \
                             and st.shed_level == LEVEL_FULL:
                         drop.append(tenant)
@@ -511,12 +531,12 @@ class AdmissionController:
             self.stats["overload_windows_total"] += 1
             self._over_streak += 1
             self._calm_streak = 0
-            if self._over_streak >= self._overload.shed_after:
+            if self._over_streak >= self._shed_after:
                 self._shed_locked()
         else:
             self._over_streak = 0
             self._calm_streak += 1
-            if self._calm_streak >= self._overload.recover_after:
+            if self._calm_streak >= self._recover_after:
                 self._calm_streak = 0
                 self._release_locked()
 

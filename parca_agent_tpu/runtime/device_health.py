@@ -47,6 +47,11 @@ import threading
 import time
 
 from parca_agent_tpu.runtime import device_telemetry as dtel
+from parca_agent_tpu.runtime.window_clock import (
+    REFERENCE_WINDOW_S,
+    check_window_s,
+    windows_for,
+)
 from parca_agent_tpu.utils import faults
 from parca_agent_tpu.utils.log import get_logger
 
@@ -117,7 +122,8 @@ class DeviceHealthRegistry:
                  failure_strikes: int = 3,
                  dead_after_trips: int = 0,
                  start_state: str = STATE_PROBING,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 window_s: float = REFERENCE_WINDOW_S):
         self._probe = probe
         self._probe_timeout = probe_timeout_s
         # Grace over the probe's own (subprocess) timeout: the in-process
@@ -127,8 +133,16 @@ class DeviceHealthRegistry:
                                 if probe_deadline_s is not None
                                 else probe_timeout_s + 5.0)
         self._promote_after = max(0, promote_after)
-        self._base_cooldown = max(1, cooldown_windows)
-        self._max_cooldown = max(self._base_cooldown, max_cooldown_windows)
+        # Cooldowns are wall-time commitments expressed at the reference
+        # 10 s window (runtime/window_clock.py): "3 windows before the
+        # first re-probe" means ~30 s of CPU-fallback patience whatever
+        # the cadence. Probe counts (promote_after) and failure strikes
+        # are per-event and stay unconverted; probe deadlines are
+        # already seconds. Exact identity at the reference cadence.
+        check_window_s(window_s)
+        self._base_cooldown = windows_for(cooldown_windows, window_s)
+        self._max_cooldown = max(self._base_cooldown, windows_for(
+            max_cooldown_windows, window_s))
         self._failure_strikes = max(1, failure_strikes)
         self._dead_after = max(0, dead_after_trips)
         self._clock = clock

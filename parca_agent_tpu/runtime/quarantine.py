@@ -40,6 +40,11 @@ import time
 
 import numpy as np
 
+from parca_agent_tpu.runtime.window_clock import (
+    REFERENCE_WINDOW_S,
+    check_window_s,
+    windows_for,
+)
 from parca_agent_tpu.utils.log import get_logger
 
 _log = get_logger("quarantine")
@@ -82,16 +87,24 @@ class QuarantineRegistry:
                  escalate_after: int = 2,
                  healthy_after_windows: int = 6,
                  deadline_s: float | None = None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter,
+                 window_s: float = REFERENCE_WINDOW_S):
         self._max_strikes = max_strikes
-        self._base_cooldown = max(1, quarantine_windows)
-        self._max_cooldown = max(self._base_cooldown,
-                                 max_quarantine_windows)
-        self._probation = max(1, probation_windows)
+        # Window-count knobs are wall-time commitments expressed at the
+        # reference 10 s cadence (runtime/window_clock.py): a 3-window
+        # cooldown means ~30 s of quarantine whatever the window length.
+        # Strike counts (max_strikes, escalate_after) are per-FAULT, not
+        # per-window, and stay unconverted. At the reference cadence the
+        # conversion is an exact identity.
+        check_window_s(window_s)
+        self._base_cooldown = windows_for(quarantine_windows, window_s)
+        self._max_cooldown = max(self._base_cooldown, windows_for(
+            max_quarantine_windows, window_s))
+        self._probation = windows_for(probation_windows, window_s)
         # 0 = straight to scalar on the first trip; N = N trips ride the
         # addresses-only level first.
         self._escalate_after = max(0, escalate_after)
-        self._healthy_after = max(1, healthy_after_windows)
+        self._healthy_after = windows_for(healthy_after_windows, window_s)
         self.deadline_s = deadline_s
         self._clock = clock
         # Optional pid -> tenant hook (runtime/admission.py's resolver):

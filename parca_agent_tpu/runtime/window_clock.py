@@ -1,0 +1,62 @@
+"""Cadence-invariant window-clock conversions.
+
+Every window-clocked state machine in runtime/ (admission token refill,
+quarantine cooldown/strike decay, device-health cooldowns) was tuned at
+the reference 10-second window (PAPER.md: "every profiling duration").
+ROADMAP item 1 makes the window length a product axis — at
+``--profiling-duration 1.0`` the same knob values would mean 10x less
+wall-clock patience and 10x smaller wall-clock budgets, silently
+changing the robustness contract.
+
+The fix is one conversion discipline, applied at construction time by
+every registry that takes a ``window_s``:
+
+  * **window-count knobs** (cooldowns, streaks, decay horizons) are
+    WALL-TIME commitments expressed in reference windows; convert with
+    :func:`windows_for` so "3 windows of cooldown" stays ~30 seconds at
+    any cadence.
+  * **per-window rate knobs** (token-bucket quotas, storm thresholds)
+    are PER-REFERENCE-WINDOW budgets; convert with :func:`per_window`
+    so "1000 samples per window" stays 100 samples/second at any
+    cadence. Burst CAPS stay absolute (refill x converted burst
+    windows), so the bankable burst is the same wall-clock budget too.
+
+At ``window_s == REFERENCE_WINDOW_S`` both conversions are exact
+identities (``round`` of an integer), so the default construction is
+bit-for-bit the pre-conversion behavior — tests/test_window_clock.py
+pins the invariance over {10.0, 1.0, 0.5}.
+"""
+
+from __future__ import annotations
+
+# The cadence every window-count and per-window-rate knob in the repo
+# was tuned at: the reference agent's 10-second profiling duration.
+REFERENCE_WINDOW_S = 10.0
+
+
+def check_window_s(window_s: float) -> float:
+    """A usable window length, or ValueError (constructors call this
+    once; cli.py raises the readable SystemExit before any registry is
+    built)."""
+    w = float(window_s)
+    if not w > 0.0:
+        raise ValueError(f"window_s must be > 0, got {window_s!r}")
+    return w
+
+
+def windows_for(n, window_s: float) -> int:
+    """A reference-window count ``n`` as a count of ``window_s``-long
+    windows covering the same wall time, never below one window.
+    Accepts floats so a caller can express sub-reference commitments
+    (``windows_for(0.3, 1.0) == 3``); the identity case
+    ``windows_for(n, 10.0) == n`` is exact for integer ``n``."""
+    w = check_window_s(window_s)
+    return max(1, round(float(n) * REFERENCE_WINDOW_S / w))
+
+
+def per_window(rate, window_s: float) -> float:
+    """A per-reference-window budget ``rate`` as a per-``window_s``
+    budget (same per-second rate). Exact identity at the reference
+    cadence."""
+    w = check_window_s(window_s)
+    return float(rate) * w / REFERENCE_WINDOW_S

@@ -187,6 +187,16 @@ SITES = {
         "per-window pid generation check (process/identity.py)",
     "zoo.scenario":
         "one zoo scenario window build (bench_zoo/scenarios.py)",
+    "zoo.path":
+        "one zoo streaming-arm feed step (bench_zoo/runner.py) — "
+        "fail-open: an injected fault is counted (path_fallbacks) and "
+        "the window ships via the one-shot close path instead, same "
+        "mass, never a lost window",
+    "soak.tick":
+        "one soak-loop accounting sample (bench_zoo/soak.py) — "
+        "fail-open: an injected fault is counted (tick_errors) and "
+        "costs that window's RSS/byte sample only, never the window "
+        "or the verdict arithmetic",
 }
 
 

@@ -210,7 +210,7 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
                    device_health=None, statics_store=None,
                    recorder=None, hotspots=None, sinks=None,
                    admission=None, identity=None, regression=None,
-                   device_telemetry=None) -> str:
+                   device_telemetry=None, soak=None) -> str:
     """Prometheus text exposition of the first-party metric contract
     (SURVEY.md section 5.5), plus the north-star aggregation metrics and
     the window flight recorder's stage histograms
@@ -640,6 +640,26 @@ def render_metrics(profilers, batch_client=None, extra: dict | None = None,
             for pt in series_sink.series():
                 buf.sample("parca_agent_sink_series_samples_total", "",
                            pt["labels"], pt["value"], mtype="counter")
+    if soak is not None:
+        # Endurance telemetry (bench_zoo/soak.py SoakStatus): live
+        # progress gauges plus a one-hot over the scenario universe so
+        # dashboards get a stable label set from window zero. The lane
+        # family mixes byte lanes and entry counts — the slope verdict,
+        # not the unit, is the contract.
+        s = soak.snapshot()
+        buf.emit("parca_agent_soak_running", bool(s.get("running")))
+        buf.emit("parca_agent_soak_rss_bytes", int(s.get("rss_bytes", 0)))
+        buf.emit("parca_agent_soak_windows_elapsed",
+                 int(s.get("windows_elapsed", 0)))
+        cur = s.get("scenario", "")
+        for name in (s.get("scenarios") or ()):
+            buf.emit("parca_agent_soak_scenario", int(name == cur),
+                     labels={"scenario": name})
+        for lane, v in sorted((s.get("lanes") or {}).items()):
+            buf.emit("parca_agent_soak_lane", v, labels={"lane": lane})
+        verdict = s.get("verdict")
+        if verdict is not None:
+            buf.emit("parca_agent_soak_passed", bool(verdict.get("passed")))
     for k, v in (extra or {}).items():
         # Extra metrics may arrive with pre-rendered labels
         # ("name{k=\"v\"}"): split so the family still gets its TYPE
@@ -657,7 +677,8 @@ class AgentHTTPServer:
                  capture_info=None, supervisor=None, quarantine=None,
                  device_health=None, statics_store=None, recorder=None,
                  hotspots=None, sinks=None, admission=None,
-                 identity=None, regression=None, device_telemetry=None):
+                 identity=None, regression=None, device_telemetry=None,
+                 soak=None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -692,7 +713,8 @@ class AgentHTTPServer:
                         admission=outer.admission,
                         identity=outer.identity,
                         regression=outer.regression,
-                        device_telemetry=outer.device_telemetry).encode())
+                        device_telemetry=outer.device_telemetry,
+                        soak=outer.soak).encode())
                 elif url.path == "/healthy":
                     self._send(200, b"ok\n")
                 elif url.path == "/healthz":
@@ -842,6 +864,8 @@ class AgentHTTPServer:
                             if outer.identity is not None else None)
                 regression = (outer.regression.snapshot()
                               if outer.regression is not None else None)
+                endurance = (outer.soak.snapshot()
+                             if outer.soak is not None else None)
                 if outer.supervisor is None:
                     body = {"status": "healthy", "actors": {}}
                     if quarantine is not None:
@@ -860,6 +884,8 @@ class AgentHTTPServer:
                         body["process_identity"] = identity
                     if regression is not None:
                         body["regression"] = regression
+                    if endurance is not None:
+                        body["endurance"] = endurance
                     self._send(200, json.dumps(body).encode(),
                                "application/json")
                     return
@@ -917,6 +943,14 @@ class AgentHTTPServer:
                     # surfaced for operators and by contract never
                     # turns readiness red.
                     body["regression"] = regression
+                if endurance is not None:
+                    # The soak verdict judges the agent's OWN leak
+                    # bars — a red soak is a CI verdict about a build,
+                    # not a liveness fact about this process. Live
+                    # progress, per-cache byte lanes, and the last
+                    # verdict are surfaced for operators and by
+                    # contract never turn readiness red.
+                    body["endurance"] = endurance
                 self._send(503 if status == "dead" else 200,
                            json.dumps(body, indent=1).encode(),
                            "application/json")
@@ -1061,6 +1095,7 @@ class AgentHTTPServer:
         self.identity = identity
         self.regression = regression
         self.device_telemetry = device_telemetry
+        self.soak = soak
         self.version = version
         self.extra_metrics = extra_metrics
         self.capture_info = capture_info

@@ -1,0 +1,95 @@
+"""Endurance soak (chaos) suite: short wall-bounded runs of the
+bench_zoo soak harness (`make soak` / `make soak-smoke`) must conserve
+sample mass with zero lost windows, the ``soak.tick`` chaos site must
+fail open (an injected sampling fault costs that window's RSS/lane
+sample only, never the window or the verdict arithmetic), and the
+soak telemetry must surface on /metrics and the never-red /healthz
+``endurance`` section.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from parca_agent_tpu.bench_zoo.soak import SoakStatus, _SlopeReg, run_soak
+from parca_agent_tpu.utils import faults
+from parca_agent_tpu.web import AgentHTTPServer, render_metrics
+
+pytestmark = pytest.mark.chaos
+
+# The chaos site this module drills (utils/faults.py SITES).
+SITE = "soak.tick"
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.install(None)
+
+
+def test_slope_regression_is_streaming_least_squares():
+    grow, flat = _SlopeReg(), _SlopeReg()
+    for i in range(100):
+        grow.add(i, 1000.0 + 7.0 * i)
+        flat.add(i, 1000.0)
+    assert grow.slope() == pytest.approx(7.0)
+    assert flat.slope() == pytest.approx(0.0)
+    assert _SlopeReg().slope() == 0.0  # n < 2 -> no verdict, not NaN
+
+
+def test_short_soak_conserves_mass_with_zero_lost_windows():
+    # Generous slope limits: a 4 s sample under CI contention is too
+    # noisy to judge leaks (that's `make soak`'s job); this pins the
+    # accounting bars and the harness plumbing.
+    status = SoakStatus()
+    v = run_soak(wall_s=4.0, seed=7, scale=0.25, window_s=1.0,
+                 rss_slope_limit=1 << 20, lane_slope_limit=1 << 16,
+                 status=status)
+    assert v["passed"], v["bars"]
+    assert v["windows"] > 0
+    assert v["windows_lost"] == 0
+    assert v["bars"]["mass_conserved"]
+    assert v["samples_fed"] > 0
+    snap = status.snapshot()
+    assert snap["running"] is False
+    assert snap["verdict"]["passed"]
+    assert snap["windows_elapsed"] == v["windows"]
+
+
+def test_injected_tick_fault_costs_the_sample_never_the_window():
+    faults.install(faults.FaultInjector.from_spec(
+        f"{SITE}:error:p=0.5", seed=42))
+    v = run_soak(wall_s=3.0, seed=9, scale=0.25, window_s=1.0,
+                 rss_slope_limit=1 << 20, lane_slope_limit=1 << 16)
+    assert v["tick_errors"] > 0
+    assert v["windows_lost"] == 0
+    assert v["bars"]["mass_conserved"]
+    assert v["passed"], v["bars"]
+
+
+def test_soak_surfaces_on_metrics_and_the_never_red_healthz_section():
+    status = SoakStatus()
+    v = run_soak(wall_s=2.0, seed=5, scale=0.25, window_s=1.0,
+                 rss_slope_limit=1 << 20, lane_slope_limit=1 << 16,
+                 status=status)
+    text = render_metrics((), soak=status)
+    assert "parca_agent_soak_rss_bytes" in text
+    assert f"parca_agent_soak_windows_elapsed {v['windows']}" in text
+    # One-hot over the whole scenario universe, stable label set.
+    assert 'parca_agent_soak_scenario{scenario="pid_reuse"}' in text
+    assert "parca_agent_soak_lane{" in text
+    assert "parca_agent_soak_passed 1" in text
+
+    srv = AgentHTTPServer(port=0, soak=status)
+    srv.start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=5).read())
+    finally:
+        srv.stop()
+    # Never-red by contract: a finished (even failed) soak reports its
+    # verdict and per-cache byte lanes without touching readiness.
+    assert body["status"] == "healthy"
+    assert body["endurance"]["verdict"]["passed"] is True
+    assert body["endurance"]["lanes"]

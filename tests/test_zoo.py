@@ -102,3 +102,58 @@ def test_injected_scenario_fault_degrades_builds_not_the_run():
     assert row["degraded_builds"] == row["windows"]
     assert row["windows_lost"] == 0
     assert row["windows_closed"] == row["windows"]
+
+
+# -- the endurance matrix: path x cadence x outage ---------------------------
+
+def test_matrix_runs_every_path_cadence_and_outage_row():
+    from parca_agent_tpu.bench_zoo import run_matrix
+
+    m = run_matrix(11, scale=0.25, names=["pid_reuse"],
+                   cadences=(10.0, 1.0), outages=("dispatch",))
+    # 3 paths x 2 cadences + 1 outage x 2 cadences, one scenario.
+    assert m["rows_total"] == 8
+    assert m["passed"], [
+        (r["scenario"], r["path"], r["window_s"], r["outage"],
+         {k: v for k, v in r["bars"].items() if not v})
+        for r in m["rows"] if not r["passed"]]
+    cross = m["cross"][0]
+    # The cross-arm contract: the fast arms ship byte-identical pprof
+    # sequences, all three arms agree on per-window mass, and the
+    # scalar digest is cadence-invariant.
+    assert cross["bars"]["path_bytes_identical@10s"]
+    assert cross["bars"]["path_bytes_identical@1s"]
+    assert cross["bars"]["path_mass_identical@10s"]
+    assert cross["bars"]["path_mass_identical@1s"]
+    assert cross["bars"]["cadence_digest_identical"]
+
+
+def test_outage_probe_demotes_and_recovers_at_subsecond_cadence():
+    row = run_scenario("fork_storm", 23, scale=0.25, outage="probe",
+                       window_s=1.0)
+    assert row["passed"], row["bars"]
+    assert row["bars"]["outage_injected"]
+    assert row["bars"]["outage_demoted"]
+    assert row["bars"]["outage_recovered"]
+    assert row["windows_lost"] == 0
+
+
+def test_outage_rows_require_the_scalar_path():
+    with pytest.raises(ValueError):
+        run_scenario("pid_reuse", 3, scale=0.25, path="pipeline",
+                     outage="dispatch")
+
+
+def test_injected_path_fault_falls_open_to_oneshot_close():
+    # Chaos site zoo.path: a poisoned streaming drain discards the
+    # feeder's partial window and falls open to the aggregator's
+    # one-shot close — counted, never a lost window.
+    faults.install(faults.FaultInjector.from_spec(
+        "zoo.path:error:count=2", seed=42))
+    try:
+        row = run_scenario("pid_reuse", 19, scale=0.25, path="streaming")
+    finally:
+        faults.install(None)
+    assert row["streaming"]["path_fallbacks"] >= 1
+    assert row["windows_lost"] == 0
+    assert row["passed"], row["bars"]
